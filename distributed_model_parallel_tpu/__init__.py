@@ -1,0 +1,31 @@
+"""distributed_model_parallel_tpu — a TPU-native distributed training framework.
+
+A ground-up JAX/XLA re-design of the capabilities of
+`timmywanttolearn/distributed_model_parallel` (reference mounted at
+/root/reference): data-parallel training (the scatter / replicate /
+parallel_apply / gather path of `torch.nn.DataParallel` and the bucketed
+DDP Reducer, re-expressed as XLA collectives over a named device mesh),
+pipeline model parallelism (the reference's autograd-transparent
+`dist.send/recv` stage transport, re-expressed as `lax.ppermute` under
+`shard_map` with static shapes), the model zoo (MobileNetV2 and variants,
+ResNet, BERT), the dataset collection, and the trainer surface (SGD +
+cosine decay + linear warmup, acc1/acc5 metrics, best-acc checkpointing
+with resume).
+
+Package layout:
+  runtime/   mesh + multi-host bootstrap (replaces dist.init_process_group)
+  models/    pure-functional model zoo (param/state pytrees, NHWC)
+  ops/       collectives, pipeline transport, attention (ring / Ulysses)
+  parallel/  DP / DDP / pipeline / tensor-parallel engines
+  data/      dataset collection + per-host sharded input pipeline
+  training/  trainer loops, optimizer/schedule, metrics, checkpointing
+  native/    C++ runtime components (data pipeline hot loop)
+"""
+
+__version__ = "0.1.0"
+
+from distributed_model_parallel_tpu.runtime.mesh import (  # noqa: F401
+    MeshSpec,
+    make_mesh,
+    local_mesh,
+)

@@ -1,0 +1,11 @@
+"""Model zoo — mirrors the reference's `model/__init__.py` re-export style
+(`code/distributed_training/model/__init__.py:1`) plus the ResNet/BERT
+families demanded by BASELINE.json's configs."""
+
+from distributed_model_parallel_tpu.models import layers  # noqa: F401
+from distributed_model_parallel_tpu.models.layers import Context, Layer  # noqa: F401
+from distributed_model_parallel_tpu.models.mobilenetv2 import (  # noqa: F401
+    mobilenet_v2,
+    mobilenet_v2_nobn,
+    split_stages,
+)

@@ -1,0 +1,384 @@
+"""Pure-functional neural-net layer library (NHWC, param/state pytrees).
+
+This replaces `torch.nn` for the model zoo. Design rules, chosen for TPU:
+
+* A layer is a `Layer(init, apply)` pair of pure functions.
+    init(key)                              -> (params, state)
+    apply(params, state, x, ctx)           -> (y, new_state)
+  `params` are trained; `state` holds non-trained buffers (BN running
+  stats). Both are plain dict pytrees, so engines can shard, split into
+  pipeline stages, or donate them without any module-object machinery.
+* NHWC activations / HWIO conv kernels — the layouts XLA tiles best onto
+  the MXU (the reference is NCHW torch, e.g.
+  `code/distributed_training/model/mobilenetv2.py:17-21`; layout is an
+  implementation choice, capability is identical).
+* BatchNorm takes an optional mesh axis name: when set, batch statistics
+  are `lax.pmean`-ed across that axis — SyncBatchNorm as a one-liner
+  (reference documents SyncBN prep inside DDP init, `Readme.md:151`).
+  When the engine runs the model under plain `jit` over a sharded batch,
+  statistics are global automatically; under `shard_map` without the axis
+  name they are per-shard, which is exactly `nn.DataParallel`'s
+  per-replica-BN semantics (`Readme.md:70-107`).
+* Initializers match torch defaults numerically (kaiming-uniform with
+  a=sqrt(5) for conv/linear ⇒ U(±1/sqrt(fan_in))) so convergence parity
+  with the reference's published accuracies is apples-to-apples.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from functools import partial
+from typing import Any, Callable, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+Params = Any
+State = Any
+
+
+@dataclasses.dataclass(frozen=True)
+class Context:
+    """Per-call context threaded through `apply`."""
+
+    train: bool = False
+    # Mesh axis name(s) over which BN stats are synchronized (SyncBN).
+    # None => local (per-shard under shard_map, global under plain jit).
+    bn_axis: Optional[str] = None
+    # PRNG key for stochastic layers (dropout); None in eval.
+    rng: Optional[jax.Array] = None
+
+    def child(self, i: int) -> "Context":
+        """Context for the i-th child of a combinator: folds the child
+        index into the rng so sibling stochastic layers draw independent
+        masks."""
+        if self.rng is None:
+            return self
+        return dataclasses.replace(self, rng=jax.random.fold_in(self.rng, i))
+
+
+@dataclasses.dataclass(frozen=True)
+class Layer:
+    init: Callable[[jax.Array], tuple[Params, State]]
+    apply: Callable[[Params, State, jax.Array, Context], tuple[jax.Array, State]]
+
+
+def _uniform(key, shape, bound, dtype=jnp.float32):
+    return jax.random.uniform(key, shape, dtype, -bound, bound)
+
+
+# ---------------------------------------------------------------------------
+# Conv / Linear / Norm primitives
+# ---------------------------------------------------------------------------
+
+
+def conv2d(
+    in_ch: int,
+    out_ch: int,
+    kernel: int,
+    *,
+    stride: int = 1,
+    padding: int = 0,
+    groups: int = 1,
+    bias: bool = False,
+) -> Layer:
+    """2-D convolution, NHWC/HWIO. `groups=channels` gives the depthwise conv
+    of the MobileNetV2 block (`mobilenetv2.py:19`)."""
+    kshape = (kernel, kernel, in_ch // groups, out_ch)
+    fan_in = (in_ch // groups) * kernel * kernel
+    bound = 1.0 / math.sqrt(fan_in)
+
+    def init(key):
+        wkey, bkey = jax.random.split(key)
+        params = {"w": _uniform(wkey, kshape, bound)}
+        if bias:
+            params["b"] = _uniform(bkey, (out_ch,), bound)
+        return params, {}
+
+    dn = lax.conv_dimension_numbers(
+        (1, 1, 1, in_ch), kshape, ("NHWC", "HWIO", "NHWC")
+    )
+
+    def apply(params, state, x, ctx):
+        y = lax.conv_general_dilated(
+            x,
+            params["w"].astype(x.dtype),
+            window_strides=(stride, stride),
+            padding=[(padding, padding), (padding, padding)],
+            dimension_numbers=dn,
+            feature_group_count=groups,
+        )
+        if bias:
+            y = y + params["b"].astype(y.dtype)
+        return y, state
+
+    return Layer(init, apply)
+
+
+def linear(in_features: int, out_features: int, *, bias: bool = True) -> Layer:
+    """Dense layer, torch-default init (`nn.Linear`, used at
+    `mobilenetv2.py:56`)."""
+    bound = 1.0 / math.sqrt(in_features)
+
+    def init(key):
+        wkey, bkey = jax.random.split(key)
+        params = {"w": _uniform(wkey, (in_features, out_features), bound)}
+        if bias:
+            params["b"] = _uniform(bkey, (out_features,), bound)
+        return params, {}
+
+    def apply(params, state, x, ctx):
+        y = x @ params["w"].astype(x.dtype)
+        if bias:
+            y = y + params["b"].astype(y.dtype)
+        return y, state
+
+    return Layer(init, apply)
+
+
+def batchnorm2d(
+    num_features: int, *, momentum: float = 0.1, eps: float = 1e-5
+) -> Layer:
+    """BatchNorm over (N, H, W) with explicit running-stat state.
+
+    Matches `nn.BatchNorm2d` semantics (normalize with biased batch var,
+    update running stats with unbiased var, momentum 0.1). Cross-replica
+    synchronization — the SyncBatchNorm the reference only documents
+    (`Readme.md:151`) — is `ctx.bn_axis`: batch mean/var are pmean-ed over
+    that mesh axis before use.
+    """
+
+    def init(key):
+        params = {
+            "scale": jnp.ones((num_features,)),
+            "bias": jnp.zeros((num_features,)),
+        }
+        state = {
+            "mean": jnp.zeros((num_features,)),
+            "var": jnp.ones((num_features,)),
+        }
+        return params, state
+
+    def apply(params, state, x, ctx):
+        reduce_axes = tuple(range(x.ndim - 1))  # all but channel
+        if ctx.train:
+            xf = x.astype(jnp.float32)
+            mean = jnp.mean(xf, axis=reduce_axes)
+            mean_sq = jnp.mean(jnp.square(xf), axis=reduce_axes)
+            if ctx.bn_axis is not None:
+                mean = lax.pmean(mean, ctx.bn_axis)
+                mean_sq = lax.pmean(mean_sq, ctx.bn_axis)
+            var = mean_sq - jnp.square(mean)  # biased, used to normalize
+            n = math.prod(x.shape[i] for i in reduce_axes)
+            if ctx.bn_axis is not None:
+                # Global element count, so the Bessel correction matches
+                # torch SyncBatchNorm and the GSPMD (global-batch) engine.
+                n = n * lax.psum(1, ctx.bn_axis)
+                unbiased = var * (n / jnp.maximum(n - 1, 1))
+            else:
+                unbiased = var * (n / max(n - 1, 1))
+            new_state = {
+                "mean": (1 - momentum) * state["mean"] + momentum * mean,
+                "var": (1 - momentum) * state["var"] + momentum * unbiased,
+            }
+        else:
+            mean, var = state["mean"], state["var"]
+            new_state = state
+        inv = lax.rsqrt(var + eps) * params["scale"]
+        y = (x.astype(jnp.float32) - mean) * inv + params["bias"]
+        return y.astype(x.dtype), new_state
+
+    return Layer(init, apply)
+
+
+def layernorm(dim: int, *, eps: float = 1e-12) -> Layer:
+    """LayerNorm over the last axis (BERT uses eps=1e-12)."""
+
+    def init(key):
+        return {"scale": jnp.ones((dim,)), "bias": jnp.zeros((dim,))}, {}
+
+    def apply(params, state, x, ctx):
+        xf = x.astype(jnp.float32)
+        mean = jnp.mean(xf, axis=-1, keepdims=True)
+        var = jnp.mean(jnp.square(xf - mean), axis=-1, keepdims=True)
+        y = (xf - mean) * lax.rsqrt(var + eps)
+        y = y * params["scale"] + params["bias"]
+        return y.astype(x.dtype), state
+
+    return Layer(init, apply)
+
+
+def embedding(vocab: int, dim: int, *, scale: float = 0.02) -> Layer:
+    def init(key):
+        return {"table": scale * jax.random.normal(key, (vocab, dim))}, {}
+
+    def apply(params, state, ids, ctx):
+        return jnp.take(params["table"], ids, axis=0), state
+
+    return Layer(init, apply)
+
+
+# ---------------------------------------------------------------------------
+# Stateless ops as layers
+# ---------------------------------------------------------------------------
+
+
+def _stateless(fn) -> Layer:
+    return Layer(
+        init=lambda key: ({}, {}),
+        apply=lambda params, state, x, ctx: (fn(x), state),
+    )
+
+
+def relu() -> Layer:
+    return _stateless(jax.nn.relu)
+
+
+def gelu() -> Layer:
+    return _stateless(partial(jax.nn.gelu, approximate=False))
+
+
+def avg_pool2d(window: int, stride: Optional[int] = None) -> Layer:
+    """`F.avg_pool2d` equivalent (used with window 4 for CIFAR at
+    `mobilenetv2.py:72-73`)."""
+    stride = stride or window
+
+    def fn(x):
+        y = lax.reduce_window(
+            x,
+            0.0,
+            lax.add,
+            (1, window, window, 1),
+            (1, stride, stride, 1),
+            "VALID",
+        )
+        return y / (window * window)
+
+    return _stateless(fn)
+
+
+def max_pool2d(window: int, stride: Optional[int] = None, padding: int = 0) -> Layer:
+    stride = stride or window
+
+    def fn(x):
+        return lax.reduce_window(
+            x,
+            -jnp.inf,
+            lax.max,
+            (1, window, window, 1),
+            (1, stride, stride, 1),
+            [(0, 0), (padding, padding), (padding, padding), (0, 0)],
+        )
+
+    return _stateless(fn)
+
+
+def global_avg_pool() -> Layer:
+    return _stateless(lambda x: jnp.mean(x, axis=(1, 2)))
+
+
+def flatten() -> Layer:
+    """`out.view(out.size(0), -1)` (`mobilenetv2.py:74`)."""
+    return _stateless(lambda x: x.reshape(x.shape[0], -1))
+
+
+def reshape_head(pool_window: int = 4) -> Layer:
+    """relu → avgpool(window) → flatten: the reference's `Reshape1` tail
+    module (`mobilenetv2.py:150-158`), used as the pipeline last-stage head
+    (`model_parallel.py:144`). Its unused near-twin `Reshape`
+    (`distributed_layers.py:64-69`) is intentionally not reproduced."""
+    return sequential(relu(), avg_pool2d(pool_window), flatten())
+
+
+def dropout(rate: float) -> Layer:
+    def apply(params, state, x, ctx):
+        if not ctx.train or rate == 0.0 or ctx.rng is None:
+            return x, state
+        keep = jax.random.bernoulli(ctx.rng, 1.0 - rate, x.shape)
+        return jnp.where(keep, x / (1.0 - rate), 0.0), state
+
+    return Layer(init=lambda key: ({}, {}), apply=apply)
+
+
+def identity() -> Layer:
+    return _stateless(lambda x: x)
+
+
+# ---------------------------------------------------------------------------
+# Combinators
+# ---------------------------------------------------------------------------
+
+
+def sequential(*layers: Layer) -> Layer:
+    """`nn.Sequential` equivalent — params/state are dicts keyed '0','1',…
+    so pipeline stage splitting is a dict-key slice, not a module surgery
+    (the reference splits with `net.layers[a:b]`, `model_parallel.py:102-144`)."""
+
+    def init(key):
+        keys = jax.random.split(key, max(len(layers), 1))
+        params, state = {}, {}
+        for i, (l, k) in enumerate(zip(layers, keys)):
+            p, s = l.init(k)
+            params[str(i)] = p
+            state[str(i)] = s
+        return params, state
+
+    def apply(params, state, x, ctx):
+        new_state = {}
+        for i, l in enumerate(layers):
+            x, s = l.apply(params[str(i)], state[str(i)], x, ctx.child(i))
+            new_state[str(i)] = s
+        return x, new_state
+
+    return Layer(init, apply)
+
+
+def named(pairs: Sequence[tuple[str, Layer]]) -> Layer:
+    """Sequential with explicit child names (conv1/bn1/... like the torch
+    modules), keeping checkpoints and stage splits readable."""
+
+    def init(key):
+        keys = jax.random.split(key, max(len(pairs), 1))
+        params, state = {}, {}
+        for (name, l), k in zip(pairs, keys):
+            p, s = l.init(k)
+            params[name] = p
+            state[name] = s
+        return params, state
+
+    def apply(params, state, x, ctx):
+        new_state = {}
+        for i, (name, l) in enumerate(pairs):
+            x, s = l.apply(params[name], state[name], x, ctx.child(i))
+            new_state[name] = s
+        return x, new_state
+
+    return Layer(init, apply)
+
+
+def residual(body: Layer, shortcut: Optional[Layer] = None) -> Layer:
+    """out = body(x) + shortcut(x); shortcut=None means identity."""
+
+    def init(key):
+        bkey, skey = jax.random.split(key)
+        bp, bs = body.init(bkey)
+        params, state = {"body": bp}, {"body": bs}
+        if shortcut is not None:
+            sp, ss = shortcut.init(skey)
+            params["shortcut"] = sp
+            state["shortcut"] = ss
+        return params, state
+
+    def apply(params, state, x, ctx):
+        y, bs = body.apply(params["body"], state["body"], x, ctx.child(0))
+        new_state = {"body": bs}
+        if shortcut is not None:
+            sc, ss = shortcut.apply(params["shortcut"], state["shortcut"], x, ctx.child(1))
+            new_state["shortcut"] = ss
+        else:
+            sc = x
+        return y + sc, new_state
+
+    return Layer(init, apply)

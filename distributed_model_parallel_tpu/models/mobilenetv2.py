@@ -1,0 +1,176 @@
+"""MobileNetV2 family — CIFAR-adapted, with BN-free variant and stage splits.
+
+Capability parity with the reference model file
+(`code/distributed_training/model/mobilenetv2.py`):
+
+* `Block` inverted-residual: expand 1x1 conv → depthwise 3x3 → project 1x1,
+  BN+ReLU after the first two, residual add when stride==1
+  (`mobilenetv2.py:10-36`).
+* 17-block `cfg` with the CIFAR stride tweaks (stride 2→1 in stage 2 and in
+  conv1; pool window 7→4) noted at `mobilenetv2.py:42,51,72`.
+* `MobileNetV2_nobn` / `Block_nobn`: BatchNorm removed except inside the
+  projection shortcut (`mobilenetv2.py:84-148`) — the model for the
+  large-batch-without-BN experiment (`Readme.md:159-177`).
+* `Reshape1`-equivalent head (relu → avgpool(4) → flatten,
+  `mobilenetv2.py:150-158`) exposed via `layers.reshape_head` for the
+  pipeline last stage.
+
+Stage splitting for pipeline parallelism reproduces the reference's
+header/medium/last partition (`model_parallel.py:102-104,129,143-144`)
+generically for any world size — `split_stages(num_stages)` returns a list
+of `Layer`s whose composition is the full network. The reference's split
+drops the ReLU after bn1 on the header stage (`model_parallel.py:103` vs
+`mobilenetv2.py:69`); we keep the ReLU (correctness over quirk) and record
+the decision here.
+"""
+
+from __future__ import annotations
+
+from typing import List, Sequence
+
+from distributed_model_parallel_tpu.models import layers as L
+
+# (expansion, out_planes, num_blocks, stride) — `mobilenetv2.py:41-47`
+CFG = [
+    (1, 16, 1, 1),
+    (6, 24, 2, 1),  # stride 2 -> 1 for CIFAR10
+    (6, 32, 3, 2),
+    (6, 64, 4, 2),
+    (6, 96, 3, 1),
+    (6, 160, 3, 2),
+    (6, 320, 1, 1),
+]
+
+
+def _block(in_planes: int, out_planes: int, expansion: int, stride: int,
+           batchnorm: bool = True) -> L.Layer:
+    """Inverted-residual block (`mobilenetv2.py:10-36`; no-BN variant
+    `:84-109`). Note the no-BN variant keeps BN inside the shortcut — the
+    reference does too (`mobilenetv2.py:100-103`)."""
+    planes = expansion * in_planes
+    body_parts = [
+        ("conv1", L.conv2d(in_planes, planes, 1)),
+        *([("bn1", L.batchnorm2d(planes))] if batchnorm else []),
+        ("relu1", L.relu()),
+        ("conv2", L.conv2d(planes, planes, 3, stride=stride, padding=1,
+                           groups=planes)),
+        *([("bn2", L.batchnorm2d(planes))] if batchnorm else []),
+        ("relu2", L.relu()),
+        ("conv3", L.conv2d(planes, out_planes, 1)),
+        *([("bn3", L.batchnorm2d(out_planes))] if batchnorm else []),
+    ]
+    body = L.named(body_parts)
+    if stride != 1:
+        return body  # no residual when downsampling (`mobilenetv2.py:34`)
+    if in_planes != out_planes:
+        shortcut = L.named([
+            ("conv", L.conv2d(in_planes, out_planes, 1)),
+            ("bn", L.batchnorm2d(out_planes)),  # BN kept even in nobn variant
+        ])
+    else:
+        shortcut = None
+    return L.residual(body, shortcut)
+
+
+def _make_blocks(in_planes: int = 32, batchnorm: bool = True) -> List[L.Layer]:
+    """The 17 `Block`s of `_make_layers` (`mobilenetv2.py:59-66`)."""
+    blocks = []
+    for expansion, out_planes, num_blocks, stride in CFG:
+        for s in [stride] + [1] * (num_blocks - 1):
+            blocks.append(_block(in_planes, out_planes, expansion, s, batchnorm))
+            in_planes = out_planes
+    return blocks
+
+
+def _stem(batchnorm: bool) -> L.Layer:
+    return L.named([
+        ("conv1", L.conv2d(3, 32, 3, stride=1, padding=1)),
+        *([("bn1", L.batchnorm2d(32))] if batchnorm else []),
+        ("relu", L.relu()),
+    ])
+
+
+def _head(num_classes: int, batchnorm: bool) -> L.Layer:
+    return L.named([
+        ("conv2", L.conv2d(320, 1280, 1)),
+        *([("bn2", L.batchnorm2d(1280))] if batchnorm else []),
+        ("reshape", L.reshape_head(4)),  # relu+avgpool(4)+flatten, `:70-74`
+        ("linear", L.linear(1280, num_classes)),
+    ])
+
+
+def mobilenet_v2(num_classes: int = 10, *, batchnorm: bool = True) -> L.Layer:
+    """Full network (`MobileNetV2`, `mobilenetv2.py:39-77`; set
+    `batchnorm=False` for `MobileNetV2_nobn`, `:111-148`)."""
+    return L.named([
+        ("stem", _stem(batchnorm)),
+        ("blocks", L.sequential(*_make_blocks(batchnorm=batchnorm))),
+        ("head", _head(num_classes, batchnorm)),
+    ])
+
+
+def mobilenet_v2_nobn(num_classes: int = 10) -> L.Layer:
+    return mobilenet_v2(num_classes, batchnorm=False)
+
+
+def _cuts(num_stages: int, boundaries: Sequence[int] | None, n: int) -> List[int]:
+    if num_stages < 1 or num_stages > n:
+        raise ValueError(f"num_stages must be in [1,{n}]")
+    if boundaries is None:
+        base, rem = divmod(n, num_stages)
+        counts = [base + (1 if i < rem else 0) for i in range(num_stages)]
+        boundaries = []
+        acc = 0
+        for c in counts[:-1]:
+            acc += c
+            boundaries.append(acc)
+    if len(boundaries) != num_stages - 1:
+        raise ValueError("need num_stages-1 boundaries")
+    return [0, *boundaries, n]
+
+
+def split_stages(num_stages: int, num_classes: int = 10, *,
+                 batchnorm: bool = True,
+                 boundaries: Sequence[int] | None = None) -> List[L.Layer]:
+    """Partition into pipeline stages.
+
+    Default boundaries generalize the reference's ws=4 split (`model_parallel.py`
+    rank0 → stem+blocks[0:3] `:102-104`; middle rank r → blocks[6r-3:6r+3]
+    `:129`; last → blocks[15:]+head `:143-144`): blocks are distributed as
+    evenly as possible with stem on stage 0 and head on the last stage.
+    Pass `boundaries` (len num_stages-1, cut points in [0,17]) to override —
+    `boundaries=[3, 9, 15]` reproduces the reference ws=4 split exactly.
+    """
+    blocks = _make_blocks(batchnorm=batchnorm)
+    n = len(blocks)
+    cuts = _cuts(num_stages, boundaries, n)
+    stages = []
+    for i in range(num_stages):
+        parts = list(blocks[cuts[i]:cuts[i + 1]])
+        if i == 0:
+            parts.insert(0, _stem(batchnorm))
+        if i == num_stages - 1:
+            parts.append(_head(num_classes, batchnorm))
+        stages.append(L.sequential(*parts))
+    return stages
+
+
+def partition_pytree(tree, num_stages: int, *,
+                     boundaries: Sequence[int] | None = None) -> List[dict]:
+    """Map a full-model params (or state) pytree onto the `split_stages`
+    structure, so a single-device checkpoint loads into a pipeline run and
+    vice versa. The full tree is `{stem, blocks:{'0'..'16'}, head}`; stage
+    trees are sequential-keyed (`'0','1',...`) in the same part order
+    `split_stages` builds."""
+    n = 17
+    cuts = _cuts(num_stages, boundaries, n)
+    out = []
+    for i in range(num_stages):
+        parts = []
+        if i == 0:
+            parts.append(tree["stem"])
+        parts.extend(tree["blocks"][str(b)] for b in range(cuts[i], cuts[i + 1]))
+        if i == num_stages - 1:
+            parts.append(tree["head"])
+        out.append({str(j): p for j, p in enumerate(parts)})
+    return out

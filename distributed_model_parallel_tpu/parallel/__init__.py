@@ -1,0 +1,5 @@
+from distributed_model_parallel_tpu.parallel.data_parallel import (  # noqa: F401
+    DataParallelEngine,
+    DDPEngine,
+    TrainState,
+)

@@ -1,0 +1,11 @@
+from distributed_model_parallel_tpu.runtime.mesh import (  # noqa: F401
+    MeshSpec,
+    make_mesh,
+    local_mesh,
+)
+from distributed_model_parallel_tpu.runtime.dist import (  # noqa: F401
+    initialize_backend,
+    process_index,
+    process_count,
+    is_primary,
+)

@@ -1,0 +1,11 @@
+from distributed_model_parallel_tpu.training.optim import (  # noqa: F401
+    SGD,
+    SGDState,
+    cosine_warmup_schedule,
+)
+from distributed_model_parallel_tpu.training.metrics import (  # noqa: F401
+    Meter,
+    accuracy,
+    cross_entropy,
+    topk_correct,
+)

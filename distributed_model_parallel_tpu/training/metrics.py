@@ -1,0 +1,54 @@
+"""Loss + accuracy metrics matching the reference trainer.
+
+* cross-entropy from logits = `nn.CrossEntropyLoss` (`data_parallel.py:89`)
+* `accuracy(output, target, topk=(1,5))` = `utils.py:215-229`, returning
+  percentages.
+* `Meter` = the running averages the reference accumulates by hand
+  (`utils.py:36-76`: batch_time_avg / data_time_avg / acc1_avg / loss_avg).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+
+
+def cross_entropy(logits: jax.Array, labels: jax.Array) -> jax.Array:
+    """Mean softmax cross-entropy over the batch, computed in f32."""
+    logits = logits.astype(jnp.float32)
+    logz = jax.nn.logsumexp(logits, axis=-1)
+    true_logit = jnp.take_along_axis(logits, labels[:, None], axis=-1)[:, 0]
+    return jnp.mean(logz - true_logit)
+
+
+def topk_correct(logits: jax.Array, labels: jax.Array, k: int) -> jax.Array:
+    """Count of samples whose label is in the top-k logits (sum, not %,
+    so counts psum correctly across shards)."""
+    _, pred = jax.lax.top_k(logits, k)
+    hit = jnp.any(pred == labels[:, None], axis=-1)
+    return jnp.sum(hit.astype(jnp.float32))
+
+
+def accuracy(logits: jax.Array, labels: jax.Array, topk=(1,)) -> list[jax.Array]:
+    """Percentage top-k accuracies — same contract as reference
+    `accuracy` (`utils.py:215-229`)."""
+    n = labels.shape[0]
+    return [100.0 * topk_correct(logits, labels, k) / n for k in topk]
+
+
+@dataclasses.dataclass
+class Meter:
+    """Streaming average (host-side)."""
+
+    total: float = 0.0
+    count: int = 0
+
+    def update(self, value: float, n: int = 1) -> None:
+        self.total += float(value) * n
+        self.count += n
+
+    @property
+    def avg(self) -> float:
+        return self.total / max(self.count, 1)

