@@ -1,0 +1,68 @@
+"""Benchmark entry point — prints ONE JSON line for the driver.
+
+Headline metric: MobileNetV2 CIFAR-10 data-parallel training throughput
+(images/sec across the whole mesh), the exact workload behind the
+reference's only published performance table: `nn.DataParallel`, batch 512,
+0.396 s/batch on 4 GPUs = 1292.9 images/sec (`Readme.md:283-287`,
+SURVEY.md §6). `vs_baseline` is our images/sec divided by that number.
+
+Runs on whatever devices are present (one real TPU chip under the driver;
+the virtual CPU mesh if JAX_PLATFORMS=cpu is forced).
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from distributed_model_parallel_tpu.models.mobilenetv2 import mobilenet_v2
+from distributed_model_parallel_tpu.parallel.data_parallel import DataParallelEngine
+from distributed_model_parallel_tpu.runtime.mesh import MeshSpec, make_mesh
+from distributed_model_parallel_tpu.training.optim import SGD
+
+# Reference: DP 0.396 s/batch @ global batch 512 on 4 GPUs (Readme.md:283-287).
+BASELINE_IMG_PER_SEC = 512 / 0.396
+
+BATCH = 512
+WARMUP = 5
+ITERS = 30
+
+
+def main() -> None:
+    mesh = make_mesh(MeshSpec(data=-1))
+    engine = DataParallelEngine(
+        model=mobilenet_v2(10), optimizer=SGD(), mesh=mesh
+    )
+    state = engine.init_state(jax.random.PRNGKey(0))
+
+    rng = np.random.RandomState(0)
+    images = rng.rand(BATCH, 32, 32, 3).astype(np.float32)
+    labels = rng.randint(0, 10, size=(BATCH,)).astype(np.int32)
+    images, labels = engine.shard_batch(images, labels)
+    lr = jnp.float32(0.2)
+
+    for _ in range(WARMUP):
+        state, metrics = engine.train_step(state, images, labels, lr)
+    jax.block_until_ready(state)
+
+    t0 = time.perf_counter()
+    for _ in range(ITERS):
+        state, metrics = engine.train_step(state, images, labels, lr)
+    jax.block_until_ready(state)
+    dt = time.perf_counter() - t0
+
+    img_per_sec = BATCH * ITERS / dt
+    print(json.dumps({
+        "metric": "mobilenetv2_cifar10_dp_train_throughput",
+        "value": round(img_per_sec, 1),
+        "unit": "images/sec",
+        "vs_baseline": round(img_per_sec / BASELINE_IMG_PER_SEC, 3),
+    }))
+
+
+if __name__ == "__main__":
+    main()

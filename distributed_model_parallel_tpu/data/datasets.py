@@ -71,7 +71,7 @@ def _load_cifar10_batches(root: str) -> Optional[Tuple[np.ndarray, ...]]:
     tar = os.path.join(root, "cifar-10-python.tar.gz")
     if not os.path.isdir(d) and os.path.isfile(tar):
         with tarfile.open(tar) as tf:
-            tf.extractall(root)
+            tf.extractall(root, filter="data")
     if not os.path.isdir(d):
         return None
 

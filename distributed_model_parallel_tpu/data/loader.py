@@ -74,17 +74,20 @@ class Loader:
     drop_last: bool = True
 
     def __post_init__(self):
-        if self.batch_size % 1:
-            raise ValueError
+        if self.batch_size < 1:
+            raise ValueError(f"batch_size must be >= 1, got {self.batch_size}")
         self._epoch = 0
 
     def set_epoch(self, epoch: int) -> None:
         self._epoch = epoch
 
     def __len__(self) -> int:
-        n = len(self.dataset)
-        # Exact size of this host's strided shard (not floored).
-        per_host = (n - self.process_index + self.process_count - 1) // self.process_count
+        # Every host sees the same padded shard size (ceil(n/P)), so batch
+        # counts agree across hosts — without this, a host with a shorter
+        # shard exits its epoch loop early and the remaining hosts hang in
+        # the next collective (torch's DistributedSampler pads for the same
+        # reason).
+        per_host = -(-len(self.dataset) // self.process_count)
         if self.drop_last:
             return per_host // self.batch_size
         return -(-per_host // self.batch_size)
@@ -93,6 +96,12 @@ class Loader:
         n = len(self.dataset)
         rng = np.random.RandomState(self.seed + self._epoch)
         order = rng.permutation(n) if self.shuffle else np.arange(n)
+        # Pad to a multiple of process_count by wrapping (DistributedSampler
+        # semantics) so every host's strided shard has identical length.
+        per_host = -(-n // self.process_count)
+        pad = per_host * self.process_count - n
+        if pad:
+            order = np.concatenate([order, order[:pad]])
         mine = order[self.process_index::self.process_count]
         aug_rng = np.random.RandomState(
             (self.seed + self._epoch) * 1009 + self.process_index

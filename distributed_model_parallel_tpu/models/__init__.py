@@ -9,3 +9,8 @@ from distributed_model_parallel_tpu.models.mobilenetv2 import (  # noqa: F401
     mobilenet_v2_nobn,
     split_stages,
 )
+from distributed_model_parallel_tpu.models.resnet import (  # noqa: F401
+    resnet,
+    resnet18,
+    resnet50,
+)

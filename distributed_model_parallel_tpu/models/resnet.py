@@ -1,0 +1,173 @@
+"""ResNet family — the BASELINE.json benchmark models.
+
+The reference repo itself ships only MobileNetV2, but its benchmark spec
+(`BASELINE.json` configs) names ResNet-18 (CIFAR-10, single process) and
+ResNet-50 (ImageNet, DataParallel / DDP up to 64 ranks) as the workloads,
+and the north-star metric is ResNet-50 images/sec/chip. This module
+provides both, in the same pure-functional `Layer` style as the rest of
+the zoo so every parallel engine (DP / DDP / pipeline / TP) consumes them
+unchanged.
+
+Architecture follows the canonical torchvision definitions (BasicBlock for
+18/34, Bottleneck with expansion 4 for 50/101/152), with the standard
+CIFAR adaptation (3x3 stride-1 stem, no maxpool) available for the
+"ResNet-18 CIFAR-10" config — the same adaptation the reference applies to
+MobileNetV2 for CIFAR (`code/distributed_training/model/mobilenetv2.py:42,51,72`).
+
+For pipeline parallelism, `split_stages` partitions the residual blocks
+across stages exactly like the MobileNetV2 splitter (stem with stage 0,
+classifier head with the last stage).
+"""
+
+from __future__ import annotations
+
+from typing import List, Sequence
+
+from distributed_model_parallel_tpu.models import layers as L
+
+
+def _basic_block(in_planes: int, planes: int, stride: int) -> L.Layer:
+    """conv3x3-BN-ReLU-conv3x3-BN (+projection shortcut), ReLU after add."""
+    body = L.named([
+        ("conv1", L.conv2d(in_planes, planes, 3, stride=stride, padding=1)),
+        ("bn1", L.batchnorm2d(planes)),
+        ("relu", L.relu()),
+        ("conv2", L.conv2d(planes, planes, 3, stride=1, padding=1)),
+        ("bn2", L.batchnorm2d(planes)),
+    ])
+    shortcut = None
+    if stride != 1 or in_planes != planes:
+        shortcut = L.named([
+            ("conv", L.conv2d(in_planes, planes, 1, stride=stride)),
+            ("bn", L.batchnorm2d(planes)),
+        ])
+    return L.sequential(L.residual(body, shortcut), L.relu())
+
+
+def _bottleneck(in_planes: int, planes: int, stride: int) -> L.Layer:
+    """1x1 reduce — 3x3 — 1x1 expand(×4), ReLU after the residual add."""
+    out_planes = planes * 4
+    body = L.named([
+        ("conv1", L.conv2d(in_planes, planes, 1)),
+        ("bn1", L.batchnorm2d(planes)),
+        ("relu1", L.relu()),
+        ("conv2", L.conv2d(planes, planes, 3, stride=stride, padding=1)),
+        ("bn2", L.batchnorm2d(planes)),
+        ("relu2", L.relu()),
+        ("conv3", L.conv2d(planes, out_planes, 1)),
+        ("bn3", L.batchnorm2d(out_planes)),
+    ])
+    shortcut = None
+    if stride != 1 or in_planes != out_planes:
+        shortcut = L.named([
+            ("conv", L.conv2d(in_planes, out_planes, 1, stride=stride)),
+            ("bn", L.batchnorm2d(out_planes)),
+        ])
+    return L.sequential(L.residual(body, shortcut), L.relu())
+
+
+_SPECS = {
+    18: ("basic", [2, 2, 2, 2]),
+    34: ("basic", [3, 4, 6, 3]),
+    50: ("bottleneck", [3, 4, 6, 3]),
+    101: ("bottleneck", [3, 4, 23, 3]),
+    152: ("bottleneck", [3, 8, 36, 3]),
+}
+
+
+def _make_blocks(depth: int) -> tuple[List[L.Layer], int]:
+    kind, counts = _SPECS[depth]
+    block = _basic_block if kind == "basic" else _bottleneck
+    expansion = 1 if kind == "basic" else 4
+    blocks: List[L.Layer] = []
+    in_planes = 64
+    for stage_i, (planes, n) in enumerate(zip([64, 128, 256, 512], counts)):
+        for b in range(n):
+            stride = 2 if (stage_i > 0 and b == 0) else 1
+            blocks.append(block(in_planes, planes, stride))
+            in_planes = planes * expansion
+    return blocks, in_planes
+
+
+def _stem(cifar: bool) -> L.Layer:
+    if cifar:
+        return L.named([
+            ("conv1", L.conv2d(3, 64, 3, stride=1, padding=1)),
+            ("bn1", L.batchnorm2d(64)),
+            ("relu", L.relu()),
+        ])
+    return L.named([
+        ("conv1", L.conv2d(3, 64, 7, stride=2, padding=3)),
+        ("bn1", L.batchnorm2d(64)),
+        ("relu", L.relu()),
+        ("maxpool", L.max_pool2d(3, 2, padding=1)),
+    ])
+
+
+def _head(feat: int, num_classes: int) -> L.Layer:
+    return L.named([
+        ("avgpool", L.global_avg_pool()),
+        ("fc", L.linear(feat, num_classes)),
+    ])
+
+
+def resnet(depth: int, num_classes: int = 1000, *, cifar: bool = False) -> L.Layer:
+    """Build ResNet-{18,34,50,101,152}. `cifar=True` swaps in the 3x3
+    stride-1 stem with no maxpool (the standard CIFAR adaptation)."""
+    blocks, feat = _make_blocks(depth)
+    return L.named([
+        ("stem", _stem(cifar)),
+        ("blocks", L.sequential(*blocks)),
+        ("head", _head(feat, num_classes)),
+    ])
+
+
+def resnet18(num_classes: int = 10, *, cifar: bool = True) -> L.Layer:
+    """The 'ResNet-18 CIFAR-10 single-process' BASELINE config."""
+    return resnet(18, num_classes, cifar=cifar)
+
+
+def resnet50(num_classes: int = 1000, *, cifar: bool = False) -> L.Layer:
+    """The north-star benchmark model (images/sec/chip)."""
+    return resnet(50, num_classes, cifar=cifar)
+
+
+def split_stages(depth: int, num_stages: int, num_classes: int = 1000, *,
+                 cifar: bool = False,
+                 boundaries: Sequence[int] | None = None) -> List[L.Layer]:
+    """Partition a ResNet into pipeline stages (stem on stage 0, head on the
+    last), mirroring `mobilenetv2.split_stages`."""
+    blocks, feat = _make_blocks(depth)
+    n = len(blocks)
+    from distributed_model_parallel_tpu.models.mobilenetv2 import _cuts
+    cuts = _cuts(num_stages, boundaries, n)
+    stages = []
+    for i in range(num_stages):
+        parts = list(blocks[cuts[i]:cuts[i + 1]])
+        if i == 0:
+            parts.insert(0, _stem(cifar))
+        if i == num_stages - 1:
+            parts.append(_head(feat, num_classes))
+        stages.append(L.sequential(*parts))
+    return stages
+
+
+def partition_pytree(tree, depth: int, num_stages: int, *,
+                     boundaries: Sequence[int] | None = None) -> List[dict]:
+    """Map a full-model params/state pytree ({stem, blocks, head}) onto the
+    `split_stages` structure, mirroring `mobilenetv2.partition_pytree` —
+    single-device checkpoints load into pipeline runs and vice versa."""
+    from distributed_model_parallel_tpu.models.mobilenetv2 import _cuts
+    _, counts = _SPECS[depth]
+    n = sum(counts)
+    cuts = _cuts(num_stages, boundaries, n)
+    out = []
+    for i in range(num_stages):
+        parts = []
+        if i == 0:
+            parts.append(tree["stem"])
+        parts.extend(tree["blocks"][str(b)] for b in range(cuts[i], cuts[i + 1]))
+        if i == num_stages - 1:
+            parts.append(tree["head"])
+        out.append({str(j): p for j, p in enumerate(parts)})
+    return out

@@ -48,6 +48,22 @@ from distributed_model_parallel_tpu.training.metrics import (
 from distributed_model_parallel_tpu.training.optim import SGD, SGDState
 
 
+def _place_batch(arrays, sharding: NamedSharding):
+    """Host batch → global array sharded along 'data'.
+
+    Single-host: a straight `device_put` split across local devices. On a
+    multi-host mesh each host hands in only its *local* shard (the Loader's
+    per-host contract), so the global array must be assembled from
+    process-local data — `device_put` would wrongly treat the local shard
+    as the full global batch.
+    """
+    if jax.process_count() == 1:
+        return tuple(jax.device_put(a, sharding) for a in arrays)
+    return tuple(
+        jax.make_array_from_process_local_data(sharding, a) for a in arrays
+    )
+
+
 class TrainState(NamedTuple):
     """The replicated training pytree: the equivalent of the reference's
     (net.state_dict, optimizer, epoch) triple (`data_parallel.py:146-151`)."""
@@ -130,10 +146,7 @@ class DataParallelEngine:
     def shard_batch(self, images, labels):
         """Place a host batch onto the mesh, split along 'data' — the
         scatter that never touches a device 0."""
-        return (
-            jax.device_put(images, self._batch),
-            jax.device_put(labels, self._batch),
-        )
+        return _place_batch((images, labels), self._batch)
 
 
 @dataclasses.dataclass
@@ -225,7 +238,4 @@ class DDPEngine:
         return jax.device_put(ts, self._repl)
 
     def shard_batch(self, images, labels):
-        return (
-            jax.device_put(images, self._batch),
-            jax.device_put(labels, self._batch),
-        )
+        return _place_batch((images, labels), self._batch)
