@@ -101,7 +101,9 @@ class Loader:
         per_host = -(-n // self.process_count)
         pad = per_host * self.process_count - n
         if pad:
-            order = np.concatenate([order, order[:pad]])
+            # np.tile handles pad > n (tiny dataset, many hosts) — torch's
+            # DistributedSampler repeats the index list the same way.
+            order = np.concatenate([order, np.tile(order, -(-pad // n))[:pad]])
         mine = order[self.process_index::self.process_count]
         aug_rng = np.random.RandomState(
             (self.seed + self._epoch) * 1009 + self.process_index

@@ -29,6 +29,7 @@ from __future__ import annotations
 from typing import List, Sequence
 
 from distributed_model_parallel_tpu.models import layers as L
+from distributed_model_parallel_tpu.models import staging
 
 # (expansion, out_planes, num_blocks, stride) — `mobilenetv2.py:41-47`
 CFG = [
@@ -113,26 +114,10 @@ def mobilenet_v2_nobn(num_classes: int = 10) -> L.Layer:
     return mobilenet_v2(num_classes, batchnorm=False)
 
 
-def _cuts(num_stages: int, boundaries: Sequence[int] | None, n: int) -> List[int]:
-    if num_stages < 1 or num_stages > n:
-        raise ValueError(f"num_stages must be in [1,{n}]")
-    if boundaries is None:
-        base, rem = divmod(n, num_stages)
-        counts = [base + (1 if i < rem else 0) for i in range(num_stages)]
-        boundaries = []
-        acc = 0
-        for c in counts[:-1]:
-            acc += c
-            boundaries.append(acc)
-    if len(boundaries) != num_stages - 1:
-        raise ValueError("need num_stages-1 boundaries")
-    return [0, *boundaries, n]
-
-
 def split_stages(num_stages: int, num_classes: int = 10, *,
                  batchnorm: bool = True,
                  boundaries: Sequence[int] | None = None) -> List[L.Layer]:
-    """Partition into pipeline stages.
+    """Partition into pipeline stages (see `models/staging.py`).
 
     Default boundaries generalize the reference's ws=4 split (`model_parallel.py`
     rank0 → stem+blocks[0:3] `:102-104`; middle rank r → blocks[6r-3:6r+3]
@@ -142,35 +127,16 @@ def split_stages(num_stages: int, num_classes: int = 10, *,
     `boundaries=[3, 9, 15]` reproduces the reference ws=4 split exactly.
     """
     blocks = _make_blocks(batchnorm=batchnorm)
-    n = len(blocks)
-    cuts = _cuts(num_stages, boundaries, n)
-    stages = []
-    for i in range(num_stages):
-        parts = list(blocks[cuts[i]:cuts[i + 1]])
-        if i == 0:
-            parts.insert(0, _stem(batchnorm))
-        if i == num_stages - 1:
-            parts.append(_head(num_classes, batchnorm))
-        stages.append(L.sequential(*parts))
-    return stages
+    cuts = staging.split_points(num_stages, boundaries, len(blocks))
+    return staging.assemble_stages(
+        blocks, _stem(batchnorm), _head(num_classes, batchnorm), cuts
+    )
 
 
 def partition_pytree(tree, num_stages: int, *,
                      boundaries: Sequence[int] | None = None) -> List[dict]:
     """Map a full-model params (or state) pytree onto the `split_stages`
     structure, so a single-device checkpoint loads into a pipeline run and
-    vice versa. The full tree is `{stem, blocks:{'0'..'16'}, head}`; stage
-    trees are sequential-keyed (`'0','1',...`) in the same part order
-    `split_stages` builds."""
-    n = 17
-    cuts = _cuts(num_stages, boundaries, n)
-    out = []
-    for i in range(num_stages):
-        parts = []
-        if i == 0:
-            parts.append(tree["stem"])
-        parts.extend(tree["blocks"][str(b)] for b in range(cuts[i], cuts[i + 1]))
-        if i == num_stages - 1:
-            parts.append(tree["head"])
-        out.append({str(j): p for j, p in enumerate(parts)})
-    return out
+    vice versa (tree layout documented in `staging.partition_tree`)."""
+    cuts = staging.split_points(num_stages, boundaries, 17)
+    return staging.partition_tree(tree, cuts)

@@ -24,6 +24,7 @@ from __future__ import annotations
 from typing import List, Sequence
 
 from distributed_model_parallel_tpu.models import layers as L
+from distributed_model_parallel_tpu.models import staging
 
 
 def _basic_block(in_planes: int, planes: int, stride: int) -> L.Layer:
@@ -136,38 +137,19 @@ def split_stages(depth: int, num_stages: int, num_classes: int = 1000, *,
                  cifar: bool = False,
                  boundaries: Sequence[int] | None = None) -> List[L.Layer]:
     """Partition a ResNet into pipeline stages (stem on stage 0, head on the
-    last), mirroring `mobilenetv2.split_stages`."""
+    last), via the shared `models/staging.py` convention."""
     blocks, feat = _make_blocks(depth)
-    n = len(blocks)
-    from distributed_model_parallel_tpu.models.mobilenetv2 import _cuts
-    cuts = _cuts(num_stages, boundaries, n)
-    stages = []
-    for i in range(num_stages):
-        parts = list(blocks[cuts[i]:cuts[i + 1]])
-        if i == 0:
-            parts.insert(0, _stem(cifar))
-        if i == num_stages - 1:
-            parts.append(_head(feat, num_classes))
-        stages.append(L.sequential(*parts))
-    return stages
+    cuts = staging.split_points(num_stages, boundaries, len(blocks))
+    return staging.assemble_stages(
+        blocks, _stem(cifar), _head(feat, num_classes), cuts
+    )
 
 
 def partition_pytree(tree, depth: int, num_stages: int, *,
                      boundaries: Sequence[int] | None = None) -> List[dict]:
     """Map a full-model params/state pytree ({stem, blocks, head}) onto the
-    `split_stages` structure, mirroring `mobilenetv2.partition_pytree` —
+    `split_stages` structure (shared `staging.partition_tree` convention) —
     single-device checkpoints load into pipeline runs and vice versa."""
-    from distributed_model_parallel_tpu.models.mobilenetv2 import _cuts
     _, counts = _SPECS[depth]
-    n = sum(counts)
-    cuts = _cuts(num_stages, boundaries, n)
-    out = []
-    for i in range(num_stages):
-        parts = []
-        if i == 0:
-            parts.append(tree["stem"])
-        parts.extend(tree["blocks"][str(b)] for b in range(cuts[i], cuts[i + 1]))
-        if i == num_stages - 1:
-            parts.append(tree["head"])
-        out.append({str(j): p for j, p in enumerate(parts)})
-    return out
+    cuts = staging.split_points(num_stages, boundaries, sum(counts))
+    return staging.partition_tree(tree, cuts)

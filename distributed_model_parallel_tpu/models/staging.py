@@ -1,0 +1,73 @@
+"""Shared pipeline-stage partitioning for stem/blocks/head model families.
+
+Generalizes the reference's hard-coded ws=4 rank split
+(`code/distributed_training/model_parallel.py:102-104,129,143-144`:
+rank 0 = stem+blocks[0:3], middle rank r = blocks[6r-3:6r+3], last =
+blocks[15:]+head) to any block count and stage count. Every model family
+(MobileNetV2, ResNet, ...) shares one cut-point algorithm and one stage /
+pytree assembly convention, so a single-device checkpoint always loads
+into the matching pipeline run and vice versa.
+"""
+
+from __future__ import annotations
+
+from typing import Any, List, Sequence
+
+from distributed_model_parallel_tpu.models import layers as L
+
+
+def split_points(num_stages: int, boundaries: Sequence[int] | None,
+                 n_blocks: int) -> List[int]:
+    """Cut points [0, ..., n_blocks] delimiting each stage's block range.
+
+    Default: blocks distributed as evenly as possible (earlier stages get
+    the remainder). Pass `boundaries` (len num_stages-1) to override —
+    e.g. [3, 9, 15] reproduces the reference's ws=4 MobileNetV2 split.
+    """
+    if num_stages < 1 or num_stages > n_blocks:
+        raise ValueError(f"num_stages must be in [1,{n_blocks}]")
+    if boundaries is None:
+        base, rem = divmod(n_blocks, num_stages)
+        counts = [base + (1 if i < rem else 0) for i in range(num_stages)]
+        boundaries = []
+        acc = 0
+        for c in counts[:-1]:
+            acc += c
+            boundaries.append(acc)
+    if len(boundaries) != num_stages - 1:
+        raise ValueError("need num_stages-1 boundaries")
+    return [0, *boundaries, n_blocks]
+
+
+def assemble_stages(blocks: Sequence[L.Layer], stem: L.Layer, head: L.Layer,
+                    cuts: Sequence[int]) -> List[L.Layer]:
+    """Stage i = blocks[cuts[i]:cuts[i+1]], with the stem prepended on
+    stage 0 and the head appended on the last (the reference's
+    header/medium/last roles, `model_parallel.py:99-157`)."""
+    num_stages = len(cuts) - 1
+    stages = []
+    for i in range(num_stages):
+        parts = list(blocks[cuts[i]:cuts[i + 1]])
+        if i == 0:
+            parts.insert(0, stem)
+        if i == num_stages - 1:
+            parts.append(head)
+        stages.append(L.sequential(*parts))
+    return stages
+
+
+def partition_tree(tree: Any, cuts: Sequence[int]) -> List[dict]:
+    """Map a full-model `{stem, blocks:{'0'..}, head}` params/state pytree
+    onto the `assemble_stages` structure (sequential-keyed stage trees in
+    the same part order)."""
+    num_stages = len(cuts) - 1
+    out = []
+    for i in range(num_stages):
+        parts = []
+        if i == 0:
+            parts.append(tree["stem"])
+        parts.extend(tree["blocks"][str(b)] for b in range(cuts[i], cuts[i + 1]))
+        if i == num_stages - 1:
+            parts.append(tree["head"])
+        out.append({str(j): p for j, p in enumerate(parts)})
+    return out

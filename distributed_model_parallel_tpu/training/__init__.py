@@ -9,3 +9,13 @@ from distributed_model_parallel_tpu.training.metrics import (  # noqa: F401
     cross_entropy,
     topk_correct,
 )
+from distributed_model_parallel_tpu.training.checkpoint import (  # noqa: F401
+    latest_exists,
+    restore_checkpoint,
+    save_checkpoint,
+)
+from distributed_model_parallel_tpu.training.trainer import (  # noqa: F401
+    EpochStats,
+    Trainer,
+    TrainerConfig,
+)

@@ -1,0 +1,137 @@
+"""Checkpoint / resume — pytree snapshots with the reference's semantics.
+
+The reference saves `{'net': state_dict, 'acc': best_acc, 'epoch': epoch}`
+to `./checkpoint/ckpt.pth` whenever validation accuracy improves
+(`code/distributed_training/data_parallel.py:143-155`) and restores it
+under `--resume` (`data_parallel.py:80-87`). Two reference quirks we fix
+(and document, per SURVEY.md §7 "faithful quirk handling"):
+
+* the reference does NOT save optimizer / scheduler state, so a resumed
+  run restarts warmup+cosine from scratch — here the full `TrainState`
+  (params, BN stats, momentum buffers, step) plus the epoch and best-acc
+  go into the snapshot;
+* the reference stores `DataParallel`-wrapped `module.*` keys (SURVEY.md
+  §3.4) — a functional pytree has no wrapper prefix, so checkpoints are
+  engine-agnostic by construction: a DP-trained snapshot restores into a
+  DDP/pipeline engine unchanged.
+
+Format: one `.npz` holding every leaf keyed by its flattened pytree path,
+plus a JSON sidecar with scalar metadata (acc, epoch, leaf treedef paths).
+Writes are host-0-only and atomic (tmp + rename); every host restores the
+same file (multi-host restore is a broadcast-by-construction since params
+are replicated).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Any, Optional, Tuple
+
+import jax
+import numpy as np
+
+
+def _path_str(path) -> str:
+    """Stable string key for a tree path (dict keys / tuple indices)."""
+    parts = []
+    for p in path:
+        if hasattr(p, "key"):
+            parts.append(str(p.key))
+        elif hasattr(p, "idx"):
+            parts.append(str(p.idx))
+        elif hasattr(p, "name"):
+            parts.append(str(p.name))
+        else:
+            parts.append(str(p))
+    return "/".join(parts)
+
+
+def save_checkpoint(
+    directory: str,
+    train_state: Any,
+    *,
+    acc: float,
+    epoch: int,
+    name: str = "ckpt",
+    extra: Optional[dict] = None,
+) -> str:
+    """Write `{directory}/{name}.npz` (+ `.json` metadata). Host-0 only —
+    the reference likewise checkpoints from the process that owns the val
+    loop (`data_parallel.py:143-155`). Returns the npz path."""
+    if jax.process_index() != 0:
+        return os.path.join(directory, f"{name}.npz")
+    os.makedirs(directory, exist_ok=True)
+    leaves_with_paths, _ = jax.tree_util.tree_flatten_with_path(train_state)
+    arrays = {}
+    for path, leaf in leaves_with_paths:
+        arrays[_path_str(path)] = np.asarray(jax.device_get(leaf))
+    npz_path = os.path.join(directory, f"{name}.npz")
+    tmp = npz_path + ".tmp"
+    with open(tmp, "wb") as f:
+        np.savez(f, **arrays)
+    os.replace(tmp, npz_path)
+
+    meta = {"acc": float(acc), "epoch": int(epoch), "keys": sorted(arrays)}
+    if extra:
+        meta.update(extra)
+    meta_path = os.path.join(directory, f"{name}.json")
+    tmp = meta_path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(meta, f, indent=1)
+    os.replace(tmp, meta_path)
+    return npz_path
+
+
+def restore_checkpoint(
+    directory: str,
+    train_state_like: Any,
+    *,
+    name: str = "ckpt",
+) -> Tuple[Any, float, int]:
+    """Restore into the structure of `train_state_like` (a template pytree,
+    e.g. a freshly initialized TrainState). Returns
+    (train_state, best_acc, start_epoch) — mirroring the reference's
+    `best_acc = checkpoint['acc']; start_epoch = checkpoint['epoch']`
+    (`data_parallel.py:85-87`). Raises FileNotFoundError when absent (the
+    reference asserts the checkpoint dir exists, `data_parallel.py:83`)."""
+    npz_path = os.path.join(directory, f"{name}.npz")
+    meta_path = os.path.join(directory, f"{name}.json")
+    if not os.path.isfile(npz_path):
+        raise FileNotFoundError(
+            f"Error: no checkpoint found at {npz_path}"
+        )
+    with np.load(npz_path) as data:
+        arrays = {k: data[k] for k in data.files}
+    leaves_with_paths, treedef = jax.tree_util.tree_flatten_with_path(
+        train_state_like
+    )
+    new_leaves = []
+    for path, leaf in leaves_with_paths:
+        key = _path_str(path)
+        if key not in arrays:
+            raise KeyError(
+                f"checkpoint at {npz_path} is missing leaf '{key}' — "
+                f"model structure changed since save"
+            )
+        arr = arrays[key]
+        want = np.shape(leaf)
+        if tuple(arr.shape) != tuple(want):
+            raise ValueError(
+                f"checkpoint leaf '{key}' has shape {arr.shape}, "
+                f"expected {want}"
+            )
+        new_leaves.append(arr.astype(np.asarray(leaf).dtype))
+    state = jax.tree_util.tree_unflatten(treedef, new_leaves)
+
+    acc, epoch = 0.0, 0
+    if os.path.isfile(meta_path):
+        with open(meta_path) as f:
+            meta = json.load(f)
+        acc = float(meta.get("acc", 0.0))
+        epoch = int(meta.get("epoch", 0))
+    return state, acc, epoch
+
+
+def latest_exists(directory: str, name: str = "ckpt") -> bool:
+    return os.path.isfile(os.path.join(directory, f"{name}.npz"))

@@ -25,8 +25,9 @@ def cross_entropy(logits: jax.Array, labels: jax.Array) -> jax.Array:
 
 def topk_correct(logits: jax.Array, labels: jax.Array, k: int) -> jax.Array:
     """Count of samples whose label is in the top-k logits (sum, not %,
-    so counts psum correctly across shards)."""
-    _, pred = jax.lax.top_k(logits, k)
+    so counts psum correctly across shards). `k` is clamped to the number
+    of classes so acc5 is well-defined on few-class heads."""
+    _, pred = jax.lax.top_k(logits, min(k, logits.shape[-1]))
     hit = jnp.any(pred == labels[:, None], axis=-1)
     return jnp.sum(hit.astype(jnp.float32))
 

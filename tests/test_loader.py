@@ -1,0 +1,56 @@
+"""Input-pipeline tests: the DistributedSampler semantics the reference
+lacks (`utils.py:21` `train_sampler=None`) — per-host disjoint shards,
+identical batch counts on every host, wrap-padding for tiny datasets."""
+
+import numpy as np
+
+from distributed_model_parallel_tpu.data.datasets import synthetic
+from distributed_model_parallel_tpu.data.loader import Loader
+
+
+def _host_batches(ds, batch, P, **kw):
+    return [
+        list(Loader(ds, batch_size=batch, process_index=p, process_count=P,
+                    shuffle=False, drop_last=False, **kw))
+        for p in range(P)
+    ]
+
+
+def test_hosts_get_equal_batch_counts_and_disjoint_coverage():
+    ds = synthetic(num_examples=64, num_classes=4, image_size=4)
+    per_host = _host_batches(ds, 4, 4)
+    counts = [len(b) for b in per_host]
+    assert counts == [4] * 4
+    # Together the hosts cover every example exactly once (n % P == 0).
+    seen = np.concatenate(
+        [lb for b in per_host for (_, lb) in b]
+    )
+    assert len(seen) == 64
+
+
+def test_padding_when_dataset_smaller_than_host_count():
+    # Regression: pad > len(order) used to under-pad, leaving some hosts
+    # with EMPTY shards — a guaranteed multi-host collective hang.
+    ds = synthetic(num_examples=2, num_classes=2, image_size=4)
+    per_host = _host_batches(ds, 1, 8)
+    counts = [len(b) for b in per_host]
+    assert counts == [1] * 8, "every host must see the same batch count"
+    for batches in per_host:
+        images, labels = batches[0]
+        assert images.shape[0] == 1 and labels.shape[0] == 1
+
+
+def test_epoch_shuffle_is_deterministic_and_host_consistent():
+    ds = synthetic(num_examples=32, num_classes=4, image_size=4)
+    a = Loader(ds, batch_size=8, seed=3, process_index=0, process_count=2)
+    b = Loader(ds, batch_size=8, seed=3, process_index=1, process_count=2)
+    a.set_epoch(5)
+    b.set_epoch(5)
+    la = np.concatenate([lb for _, lb in a])
+    lb_ = np.concatenate([lb for _, lb in b])
+    # Same epoch permutation on both hosts => strided shards are disjoint
+    # and their union is the whole (shuffled) dataset.
+    assert len(la) == len(lb_) == 16
+    # Determinism: re-iterating the same epoch gives identical batches.
+    la2 = np.concatenate([lb for _, lb in a])
+    np.testing.assert_array_equal(la, la2)
