@@ -3,3 +3,6 @@ from distributed_model_parallel_tpu.parallel.data_parallel import (  # noqa: F40
     DDPEngine,
     TrainState,
 )
+from distributed_model_parallel_tpu.parallel.pipeline import (  # noqa: F401
+    PipelineEngine,
+)

@@ -1,0 +1,338 @@
+"""Pipeline model parallelism — SPMD over the `'stage'` mesh axis.
+
+The TPU-native re-design of the reference's hand-rolled cross-process
+pipeline (`code/distributed_training/model_parallel.py` +
+`code/distributed_training/distributed_layers.py` +
+`code/distributed_training/utils.py:34-210`):
+
+reference (rank-scripted, NCCL P2P)          here (mesh-declarative, XLA)
+--------------------------------------------  --------------------------------
+one OS process per rank, role picked by       one SPMD program; every device
+`if rank == 0 / < ws-1 / == ws-1`             runs `lax.switch(axis_index
+(`model_parallel.py:99-157`)                  ('stage'), branches)` on its own
+                                              stage's weights
+`dist.send`/`dist.recv` with a runtime        `lax.ppermute` of a fixed-size
+dim/size handshake per transfer               activation buffer; shapes are
+(`distributed_layers.py:11-13,40-47`)         static at trace time, handshake
+                                              deleted (SURVEY.md §7 hard parts)
+`ForwardSend_BackwardReceive` /               plain `jax.grad` through the
+`ForwardReceive_BackwardSend` autograd        scan: the transpose of ppermute
+pair + the dummy-gradient `output.            IS the reversed permute, so the
+backward(recv_size)` hack                     backward schedule emerges from
+(`distributed_layers.py:7-62`,                autodiff instead of a hand-built
+`utils.py:61-62`)                             protocol
+exactly ONE batch in flight => all stages     GPipe fill-drain over
+but one idle (`Readme.md:283-292`: MP is      `num_microbatches` M: scan over
+4x slower than DP)                            T = M + S - 1 ticks, stage s
+                                              works on microbatch t - s;
+                                              M=1 reproduces the reference's
+                                              single-batch schedule exactly
+
+Combinable with data parallelism: a (data=D, stage=S) mesh runs D
+independent pipelines, gradients pmean over 'data' and psum over 'stage'
+in the same fused reduction.
+
+Design notes / v1 tradeoffs:
+* Stage parameters are replicated across the mesh; each device *computes*
+  only its own stage (switch branch) but *stores* all stages. For the
+  reference-scale models (MobileNetV2 ~2.3M params) this is noise; sharding
+  param storage per stage is future work.
+* Activations cross stages in one f32 buffer padded to the largest
+  inter-stage tensor, so every ppermute has one static shape. Stage I/O
+  shapes come from a setup-time `jax.eval_shape` chain over the stages —
+  the static replacement for the reference's per-transfer dim/size
+  messages.
+* Invalid ticks (pipeline bubble) still execute the branch on a zeros
+  buffer (SPMD lockstep); their outputs and BN-state updates are masked.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from functools import partial
+from typing import List, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from jax import shard_map
+
+from distributed_model_parallel_tpu.models.layers import Context, Layer
+from distributed_model_parallel_tpu.parallel.data_parallel import (
+    TrainState,
+    _place_batch,
+)
+from distributed_model_parallel_tpu.training.metrics import (
+    cross_entropy,
+    topk_correct,
+)
+from distributed_model_parallel_tpu.training.optim import SGD
+
+
+def _flat_size(shape: Sequence[int]) -> int:
+    return math.prod(shape)
+
+
+@dataclasses.dataclass
+class PipelineEngine:
+    """GPipe-style pipeline engine over the `'stage'` mesh axis.
+
+    `stages` is the output of a model family's `split_stages` (e.g.
+    `mobilenetv2.split_stages(4, boundaries=[3, 9, 15])` for the
+    reference's exact ws=4 partition). `num_microbatches=1` is the
+    reference's schedule (one batch in flight); raise it to fill the
+    pipeline (bubble fraction (S-1)/(M+S-1))."""
+
+    stages: List[Layer]
+    optimizer: SGD
+    mesh: Mesh
+    num_microbatches: int = 1
+    sync_bn: bool = False
+    donate: bool = True
+
+    def __post_init__(self):
+        mesh = self.mesh
+        if "stage" not in mesh.axis_names:
+            raise ValueError("pipeline mesh needs a 'stage' axis")
+        self.num_stages = mesh.shape["stage"]
+        if self.num_stages != len(self.stages):
+            raise ValueError(
+                f"{len(self.stages)} stages but mesh 'stage' axis has size "
+                f"{self.num_stages}"
+            )
+        self._repl = NamedSharding(mesh, P())
+        self._batch = NamedSharding(mesh, P(("data",)))
+
+        donate = (0,) if self.donate else ()
+        self.train_step = jax.jit(
+            self._make_step(train=True), donate_argnums=donate
+        )
+        self.eval_step = jax.jit(self._make_step(train=False))
+
+    # ------------------------------------------------------------ setup
+
+    def init_state(self, rng: jax.Array) -> TrainState:
+        params, state = [], []
+        for i, stage in enumerate(self.stages):
+            p, s = stage.init(jax.random.fold_in(rng, i))
+            params.append(p)
+            state.append(s)
+        params, state = tuple(params), tuple(state)
+        opt_state = self.optimizer.init(params)
+        ts = TrainState(params, state, opt_state, jnp.zeros((), jnp.int32))
+        return jax.device_put(ts, self._repl)
+
+    def shard_batch(self, images, labels):
+        return _place_batch((images, labels), self._batch)
+
+    def _stage_shapes(
+        self, params, state, x_shape, dtype, train: bool
+    ) -> List[Tuple[Tuple[int, ...], Tuple[int, ...]]]:
+        """(input_shape, output_shape) per stage from an abstract trace —
+        the static replacement for the reference's runtime dim/size
+        handshake (`distributed_layers.py:40-47`)."""
+        ctx = Context(train=train)
+        aval = jax.ShapeDtypeStruct(tuple(x_shape), dtype)
+        shapes = []
+        for i, stage in enumerate(self.stages):
+            out = jax.eval_shape(
+                lambda p, s, x, stage=stage: stage.apply(p, s, x, ctx)[0],
+                params[i], state[i], aval,
+            )
+            shapes.append((tuple(aval.shape), tuple(out.shape)))
+            aval = jax.ShapeDtypeStruct(tuple(out.shape), dtype)
+        return shapes
+
+    # ------------------------------------------------------- the program
+
+    def _make_step(self, train: bool):
+        S = self.num_stages
+        M = self.num_microbatches
+        mesh = self.mesh
+        bn_axis = "data" if self.sync_bn else None
+
+        def pipeline_forward(params, model_state, images, labels):
+            """Runs on ONE device (inside shard_map): the full fill-drain
+            schedule for this device's stage. Returns (sum CE over local
+            batch, logits for the local batch, updated state)."""
+            n_local = images.shape[0]
+            if n_local % M:
+                raise ValueError(
+                    f"local batch {n_local} not divisible by "
+                    f"num_microbatches {M}"
+                )
+            mb = n_local // M
+            shapes = self._stage_shapes(
+                params, model_state, (mb,) + images.shape[1:],
+                images.dtype, train,
+            )
+            num_classes = shapes[-1][1][-1]
+            buf_size = max(_flat_size(out) for _, out in shapes)
+            s_idx = lax.axis_index("stage")
+
+            ctx = Context(train=train, bn_axis=bn_axis)
+
+            def make_branch(i):
+                in_shape = shapes[i][0]
+
+                def branch(operand):
+                    state, buf, images_mb = operand
+                    if i == 0:
+                        x = images_mb
+                    else:
+                        x = buf[: _flat_size(in_shape)].reshape(in_shape)
+                    y, new_si = self.stages[i].apply(
+                        params[i], state[i], x, ctx
+                    )
+                    y_flat = y.reshape(-1)
+                    y_pad = jnp.zeros((buf_size,), y_flat.dtype).at[
+                        : y_flat.shape[0]
+                    ].set(y_flat)
+                    new_state = tuple(
+                        new_si if j == i else state[j] for j in range(S)
+                    )
+                    return y_pad, new_state
+
+                return branch
+
+            branches = [make_branch(i) for i in range(S)]
+            images_mbs = images.reshape((M, mb) + images.shape[1:])
+
+            def tick(carry, t):
+                buf, state, out_stack = carry
+                m = t - s_idx
+                valid = (m >= 0) & (m < M)
+                m_safe = jnp.clip(m, 0, M - 1)
+                images_mb = lax.dynamic_index_in_dim(
+                    images_mbs, m_safe, keepdims=False
+                )
+                y_pad, new_state = lax.switch(
+                    s_idx, branches, (state, buf, images_mb)
+                )
+                # Mask bubble ticks: keep old BN stats, zero the output so
+                # garbage never reaches the logits stack.
+                state = jax.tree_util.tree_map(
+                    lambda new, old: jnp.where(valid, new, old),
+                    new_state, state,
+                )
+                y_pad = jnp.where(valid, y_pad, jnp.zeros_like(y_pad))
+                logits_mb = y_pad[: mb * num_classes].reshape(mb, num_classes)
+                out_stack = lax.dynamic_update_index_in_dim(
+                    out_stack,
+                    jnp.where(
+                        valid,
+                        logits_mb,
+                        lax.dynamic_index_in_dim(out_stack, m_safe, 0, False),
+                    ),
+                    m_safe,
+                    axis=0,
+                )
+                if S > 1:
+                    buf = lax.ppermute(
+                        y_pad, "stage", [(i, i + 1) for i in range(S - 1)]
+                    )
+                return (buf, state, out_stack), None
+
+            buf0 = jnp.zeros((buf_size,), images.dtype)
+            out0 = jnp.zeros((M, mb, num_classes), images.dtype)
+            (buf, new_state, out_stack), _ = lax.scan(
+                tick,
+                (buf0, model_state, out0),
+                jnp.arange(M + S - 1),
+            )
+            logits = out_stack.reshape(n_local, num_classes)
+            # CE only counts on the last stage (the only device whose
+            # out_stack holds real logits). NO psum here: the loss must stay
+            # local so autodiff never transposes a cross-device reduction
+            # (under check_vma=False a differentiated psum mis-scales
+            # cotangents); the reversed ppermutes alone carry the true
+            # cotangents upstream, and callers psum the VALUE for
+            # reporting after grad.
+            is_last = (s_idx == S - 1).astype(logits.dtype)
+            loss_sum = cross_entropy(logits, labels) * n_local * is_last
+            return loss_sum, (logits, new_state, is_last)
+
+        def reassemble_state(new_state, s_idx):
+            """Each device updated only its own stage's BN state; rebuild
+            the replicated tuple by masked psum over 'stage'."""
+            out = []
+            for i in range(S):
+                mask = (s_idx == i).astype(jnp.float32)
+                out.append(
+                    jax.tree_util.tree_map(
+                        lambda v: lax.psum(v * mask, "stage"), new_state[i]
+                    )
+                )
+            return tuple(out)
+
+        def metrics_from(logits, labels, loss_sum, is_last):
+            m = {
+                "loss_sum": lax.psum(loss_sum, "stage"),
+                "correct1": lax.psum(
+                    topk_correct(logits, labels, 1) * is_last, "stage"
+                ),
+                "correct5": lax.psum(
+                    topk_correct(logits, labels, 5) * is_last, "stage"
+                ),
+                "count": jnp.asarray(labels.shape[0], jnp.float32),
+            }
+            return {k: lax.psum(v, "data") for k, v in m.items()}
+
+        if train:
+
+            @partial(
+                shard_map,
+                mesh=mesh,
+                in_specs=(P(), P(("data",)), P(("data",)), P()),
+                out_specs=(P(), P()),
+                check_vma=False,
+            )
+            def step(ts: TrainState, images, labels, lr):
+                s_idx = lax.axis_index("stage")
+
+                def loss_fn(params):
+                    loss_sum, aux = pipeline_forward(
+                        params, ts.model_state, images, labels
+                    )
+                    return loss_sum / images.shape[0], aux
+
+                (loss, (logits, new_state, is_last)), grads = (
+                    jax.value_and_grad(loss_fn, has_aux=True)(ts.params)
+                )
+                # Stage-i grads are nonzero only on stage-i devices; the
+                # psum over 'stage' + pmean over 'data' is the single fused
+                # all-reduce replacing per-rank optimizers
+                # (`model_parallel.py:105-149`) and the DDP Reducer.
+                grads = jax.tree_util.tree_map(
+                    lambda g: lax.pmean(lax.psum(g, "stage"), "data"), grads
+                )
+                new_state = reassemble_state(new_state, s_idx)
+                if not self.sync_bn:
+                    new_state = lax.pmean(new_state, "data")
+                params, opt_state = self.optimizer.update(
+                    ts.params, ts.opt_state, grads, lr
+                )
+                new_ts = TrainState(
+                    params, new_state, opt_state, ts.step + 1
+                )
+                loss_sum = loss * images.shape[0]
+                return new_ts, metrics_from(logits, labels, loss_sum, is_last)
+
+            return step
+
+        @partial(
+            shard_map,
+            mesh=mesh,
+            in_specs=(P(), P(("data",)), P(("data",))),
+            out_specs=P(),
+            check_vma=False,
+        )
+        def evstep(ts: TrainState, images, labels):
+            loss_sum, (logits, _, is_last) = pipeline_forward(
+                ts.params, ts.model_state, images, labels
+            )
+            return metrics_from(logits, labels, loss_sum, is_last)
+
+        return evstep

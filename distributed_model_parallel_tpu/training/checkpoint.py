@@ -17,9 +17,10 @@ under `--resume` (`data_parallel.py:80-87`). Two reference quirks we fix
 
 Format: one `.npz` holding every leaf keyed by its flattened pytree path,
 plus a JSON sidecar with scalar metadata (acc, epoch, leaf treedef paths).
-Writes are host-0-only and atomic (tmp + rename); every host restores the
-same file (multi-host restore is a broadcast-by-construction since params
-are replicated).
+Writes are host-0-only and atomic (tmp + rename). Restore works with or
+without a shared filesystem: hosts that can see the file read it; otherwise
+host-0's restore is broadcast to every process
+(`multihost_utils.broadcast_one_to_all`) so all hosts resume identically.
 """
 
 from __future__ import annotations
@@ -97,39 +98,62 @@ def restore_checkpoint(
     reference asserts the checkpoint dir exists, `data_parallel.py:83`)."""
     npz_path = os.path.join(directory, f"{name}.npz")
     meta_path = os.path.join(directory, f"{name}.json")
-    if not os.path.isfile(npz_path):
-        raise FileNotFoundError(
-            f"Error: no checkpoint found at {npz_path}"
-        )
-    with np.load(npz_path) as data:
-        arrays = {k: data[k] for k in data.files}
     leaves_with_paths, treedef = jax.tree_util.tree_flatten_with_path(
         train_state_like
     )
-    new_leaves = []
-    for path, leaf in leaves_with_paths:
-        key = _path_str(path)
-        if key not in arrays:
-            raise KeyError(
-                f"checkpoint at {npz_path} is missing leaf '{key}' — "
-                f"model structure changed since save"
-            )
-        arr = arrays[key]
-        want = np.shape(leaf)
-        if tuple(arr.shape) != tuple(want):
-            raise ValueError(
-                f"checkpoint leaf '{key}' has shape {arr.shape}, "
-                f"expected {want}"
-            )
-        new_leaves.append(arr.astype(np.asarray(leaf).dtype))
-    state = jax.tree_util.tree_unflatten(treedef, new_leaves)
 
     acc, epoch = 0.0, 0
-    if os.path.isfile(meta_path):
-        with open(meta_path) as f:
-            meta = json.load(f)
-        acc = float(meta.get("acc", 0.0))
-        epoch = int(meta.get("epoch", 0))
+    if jax.process_index() == 0 or os.path.isfile(npz_path):
+        # Host 0 (or any host sharing the filesystem) reads the file.
+        if not os.path.isfile(npz_path):
+            raise FileNotFoundError(
+                f"Error: no checkpoint found at {npz_path}"
+            )
+        with np.load(npz_path) as data:
+            arrays = {k: data[k] for k in data.files}
+        new_leaves = []
+        for path, leaf in leaves_with_paths:
+            key = _path_str(path)
+            if key not in arrays:
+                raise KeyError(
+                    f"checkpoint at {npz_path} is missing leaf '{key}' — "
+                    f"model structure changed since save"
+                )
+            arr = arrays[key]
+            want = tuple(getattr(leaf, "shape", np.shape(leaf)))
+            if tuple(arr.shape) != want:
+                raise ValueError(
+                    f"checkpoint leaf '{key}' has shape {arr.shape}, "
+                    f"expected {want}"
+                )
+            dtype = getattr(leaf, "dtype", None) or np.asarray(leaf).dtype
+            new_leaves.append(arr.astype(dtype))
+        if os.path.isfile(meta_path):
+            with open(meta_path) as f:
+                meta = json.load(f)
+            acc = float(meta.get("acc", 0.0))
+            epoch = int(meta.get("epoch", 0))
+    else:
+        # Host without the file (per-host local disks): receive host-0's
+        # copy via the broadcast below; zeros are placeholders.
+        new_leaves = [
+            np.zeros(
+                tuple(getattr(leaf, "shape", np.shape(leaf))),
+                getattr(leaf, "dtype", None) or np.asarray(leaf).dtype,
+            )
+            for _, leaf in leaves_with_paths
+        ]
+    state = jax.tree_util.tree_unflatten(treedef, new_leaves)
+
+    if jax.process_count() > 1:
+        # Hosts may have per-host disks (host 0 wrote the snapshot alone);
+        # broadcast host-0's restore so every process resumes identically.
+        from jax.experimental import multihost_utils
+
+        state, acc_ep = multihost_utils.broadcast_one_to_all(
+            (state, (np.float32(acc), np.int32(epoch)))
+        )
+        acc, epoch = float(acc_ep[0]), int(acc_ep[1])
     return state, acc, epoch
 
 

@@ -42,18 +42,15 @@ class SGD:
 
     def update(self, params, opt_state: SGDState, grads, lr):
         m, wd = self.momentum, self.weight_decay
-
-        def upd(p, buf, g):
-            g = g + wd * p
-            buf = m * buf + g
-            return p - lr * buf, buf
-
-        flat = jax.tree_util.tree_map(upd, params, opt_state.momentum, grads)
-        new_params = jax.tree_util.tree_map(
-            lambda t: t[0], flat, is_leaf=lambda t: isinstance(t, tuple)
-        )
+        # Two passes, no per-leaf tuples: a (p, buf) tuple-leaf scheme breaks
+        # when the params pytree root is itself a tuple (pipeline engines
+        # carry params as a per-stage tuple).
         new_buf = jax.tree_util.tree_map(
-            lambda t: t[1], flat, is_leaf=lambda t: isinstance(t, tuple)
+            lambda p, buf, g: m * buf + g + wd * p,
+            params, opt_state.momentum, grads,
+        )
+        new_params = jax.tree_util.tree_map(
+            lambda p, buf: p - lr * buf, params, new_buf
         )
         return new_params, SGDState(new_buf)
 

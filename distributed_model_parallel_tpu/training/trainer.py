@@ -116,6 +116,10 @@ class Trainer:
     def train_epoch(self, epoch: int) -> EpochStats:
         cfg = self.config
         lr = jnp.asarray(self.lr_fn(epoch), jnp.float32)
+        if hasattr(self.train_loader, "set_epoch"):
+            # Re-seed the per-epoch shuffle + augmentation RNG (the torch
+            # DataLoader reshuffles per epoch; our Loader keys on epoch).
+            self.train_loader.set_epoch(epoch)
         it = iter(self.train_loader)
         sums = None
         n_batches = 0

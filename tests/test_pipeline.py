@@ -1,0 +1,136 @@
+"""Pipeline-MP engine tests on the 8-device CPU mesh.
+
+Parity methodology (SURVEY.md §4): the reference validated its pipeline by
+showing it learns the same as single-device/data-parallel training
+(`Readme.md:283-294`); here the check is exact — pipeline forward equals
+the sequential composition, and the pipeline gradient step equals the
+single-device gradient step to float tolerance.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from distributed_model_parallel_tpu.models import layers as L
+from distributed_model_parallel_tpu.models import mobilenetv2
+from distributed_model_parallel_tpu.parallel.pipeline import PipelineEngine
+from distributed_model_parallel_tpu.runtime.mesh import MeshSpec, make_mesh
+from distributed_model_parallel_tpu.training.metrics import cross_entropy
+from distributed_model_parallel_tpu.training.optim import SGD
+
+
+def tiny_stages(num_classes=4):
+    """A 4-stage BN-free CNN: heterogeneous activation shapes across the
+    cuts (32ch 8x8 -> 8ch 8x8 -> 16ch 4x4 -> logits), exercising the padded
+    ppermute buffer."""
+    return [
+        L.sequential(L.conv2d(3, 32, 3, stride=1, padding=1), L.relu()),
+        L.sequential(L.conv2d(32, 8, 3, stride=1, padding=1), L.relu()),
+        L.sequential(L.conv2d(8, 16, 3, stride=2, padding=1), L.relu()),
+        L.sequential(L.global_avg_pool(), L.linear(16, num_classes)),
+    ]
+
+
+def batch(n=16, hw=8, num_classes=4, seed=0):
+    rng = np.random.RandomState(seed)
+    images = rng.rand(n, hw, hw, 3).astype(np.float32)
+    labels = rng.randint(0, num_classes, size=(n,)).astype(np.int32)
+    return jnp.asarray(images), jnp.asarray(labels)
+
+
+@pytest.fixture()
+def pp_mesh():
+    return make_mesh(MeshSpec(data=2, stage=4))
+
+
+def seq_reference(stages, params, state, images, labels, train=True):
+    """Single-device composition of the stages (the ground truth the
+    reference could only approximate with convergence curves)."""
+    full = L.sequential(*stages)
+    seq_params = {str(i): p for i, p in enumerate(params)}
+    seq_state = {str(i): s for i, s in enumerate(state)}
+
+    def loss_fn(p):
+        logits, new_s = full.apply(
+            p, seq_state, images, L.Context(train=train)
+        )
+        return cross_entropy(logits, labels), logits
+
+    (loss, logits), grads = jax.value_and_grad(loss_fn, has_aux=True)(
+        seq_params
+    )
+    return loss, logits, grads
+
+
+def test_eval_matches_sequential(pp_mesh):
+    stages = tiny_stages()
+    engine = PipelineEngine(stages, SGD(), pp_mesh, num_microbatches=2)
+    ts = engine.init_state(jax.random.PRNGKey(0))
+    images, labels = batch()
+    m = engine.eval_step(ts, *engine.shard_batch(images, labels))
+    loss, logits, _ = seq_reference(
+        stages, ts.params, ts.model_state, images, labels, train=False
+    )
+    np.testing.assert_allclose(
+        float(m["loss_sum"]) / float(m["count"]), float(loss),
+        rtol=1e-5, atol=1e-6,
+    )
+    assert float(m["count"]) == 16
+
+
+@pytest.mark.parametrize("microbatches", [1, 4])
+def test_train_step_matches_single_device(pp_mesh, microbatches):
+    """One pipeline SGD step == one single-device SGD step (BN-free model,
+    so microbatching is gradient-exact: GPipe sums microbatch grads)."""
+    stages = tiny_stages()
+    engine = PipelineEngine(
+        stages, SGD(momentum=0.9, weight_decay=1e-4), pp_mesh,
+        num_microbatches=microbatches,
+    )
+    ts = engine.init_state(jax.random.PRNGKey(1))
+    images, labels = batch()
+    lr = jnp.float32(0.1)
+
+    _, _, grads = seq_reference(
+        stages, ts.params, ts.model_state, images, labels
+    )
+    opt = SGD(momentum=0.9, weight_decay=1e-4)
+    seq_params = {str(i): p for i, p in enumerate(ts.params)}
+    expect_params, _ = opt.update(
+        seq_params, opt.init(seq_params), grads, lr
+    )
+
+    new_ts, metrics = engine.train_step(
+        ts, *engine.shard_batch(images, labels), lr
+    )
+    got = {str(i): p for i, p in enumerate(new_ts.params)}
+    flat_a = jax.tree_util.tree_leaves_with_path(expect_params)
+    flat_b = jax.tree_util.tree_leaves(got)
+    assert len(flat_a) == len(flat_b)
+    for (path, a), b in zip(flat_a, flat_b):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=2e-4, atol=2e-5,
+            err_msg=jax.tree_util.keystr(path),
+        )
+    assert np.isfinite(float(metrics["loss_sum"]))
+
+
+def test_pipeline_learns_mobilenet(pp_mesh):
+    """Convergence smoke on the real flagship split: MobileNetV2 with the
+    reference's exact ws=4 boundaries (`model_parallel.py:102-144`)."""
+    stages = mobilenetv2.split_stages(4, num_classes=4, boundaries=[3, 9, 15])
+    engine = PipelineEngine(stages, SGD(), pp_mesh, num_microbatches=2)
+    ts = engine.init_state(jax.random.PRNGKey(0))
+    images, labels = batch(n=16, hw=32)
+    images, labels = engine.shard_batch(images, labels)
+    losses = []
+    for _ in range(4):
+        ts, m = engine.train_step(ts, images, labels, jnp.float32(0.05))
+        losses.append(float(m["loss_sum"]) / float(m["count"]))
+    assert losses[-1] < losses[0]
+
+
+def test_stage_axis_size_mismatch_raises(pp_mesh):
+    with pytest.raises(ValueError, match="stage"):
+        PipelineEngine(tiny_stages()[:3], SGD(), pp_mesh)
