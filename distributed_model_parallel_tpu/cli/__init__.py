@@ -1,0 +1,9 @@
+"""Command-line entry points, flag-for-flag with the reference scripts.
+
+`python -m distributed_model_parallel_tpu.cli.data_parallel` replaces
+`python code/distributed_training/data_parallel.py` (CIFAR-10 DP training,
+`--lr --resume`); `python -m distributed_model_parallel_tpu.cli.model_parallel`
+replaces `python code/distributed_training/model_parallel.py` (pipeline
+training, `DATA --world-size N --dist-backend ...`). Every reference flag
+name is kept; TPU-only flags are additive.
+"""

@@ -1,0 +1,120 @@
+"""Shared CLI plumbing: dataset/loader construction and model selection."""
+
+from __future__ import annotations
+
+import argparse
+from typing import Tuple
+
+import jax
+import numpy as np
+
+from distributed_model_parallel_tpu.data.datasets import (
+    CIFAR10_MEAN,
+    CIFAR10_STD,
+    IMAGENET_MEAN,
+    IMAGENET_STD,
+    DatasetCollection,
+)
+from distributed_model_parallel_tpu.data.loader import Loader
+from distributed_model_parallel_tpu.models import (
+    mobilenet_v2,
+    mobilenet_v2_nobn,
+    mobilenetv2,
+    resnet,
+    resnet18,
+    resnet50,
+    tiny_cnn,
+    tinycnn,
+)
+
+MODELS = {
+    "mobilenetv2": mobilenet_v2,
+    "mobilenetv2_nobn": mobilenet_v2_nobn,
+    "resnet18": resnet18,
+    "resnet50": resnet50,
+    "tinycnn": tiny_cnn,
+}
+
+# Pipeline stage builders, kept beside MODELS so both CLIs extend in one
+# place: name -> fn(num_stages, num_classes, boundaries) -> [Layer].
+STAGE_BUILDERS = {
+    "mobilenetv2": lambda n, c, b: mobilenetv2.split_stages(
+        n, c, boundaries=b
+    ),
+    "mobilenetv2_nobn": lambda n, c, b: mobilenetv2.split_stages(
+        n, c, batchnorm=False, boundaries=b
+    ),
+    "resnet18": lambda n, c, b: resnet.split_stages(
+        18, n, c, cifar=True, boundaries=b
+    ),
+    "resnet50": lambda n, c, b: resnet.split_stages(
+        50, n, c, boundaries=b
+    ),
+    "tinycnn": lambda n, c, b: tinycnn.split_stages(n, c, boundaries=b),
+}
+
+
+def build_model(name: str, num_classes: int):
+    if name not in MODELS:
+        raise SystemExit(f"unknown model {name!r}; choose from {sorted(MODELS)}")
+    return MODELS[name](num_classes)
+
+
+def stats_for(dataset_type: str) -> Tuple[np.ndarray, np.ndarray]:
+    if dataset_type in ("CIFAR10", "Synthetic"):
+        return CIFAR10_MEAN, CIFAR10_STD
+    return IMAGENET_MEAN, IMAGENET_STD
+
+
+def build_loaders(
+    dataset_type: str,
+    data_path: str,
+    batch_size: int,
+    *,
+    val_batch_size: int | None = None,
+    augment: bool = True,
+    seed: int = 0,
+):
+    """(train_loader, val_loader, num_classes) with per-host sharding —
+    the DistributedSampler the reference lacks (`utils.py:21`)."""
+    train_ds, val_ds = DatasetCollection(dataset_type, data_path).init()
+    mean, std = stats_for(dataset_type)
+    train = Loader(
+        train_ds,
+        batch_size=batch_size,
+        shuffle=True,
+        augment=augment,
+        mean=mean,
+        std=std,
+        seed=seed,
+        process_index=jax.process_index(),
+        process_count=jax.process_count(),
+    )
+    val = Loader(
+        val_ds,
+        batch_size=val_batch_size or batch_size,
+        shuffle=False,
+        augment=False,
+        mean=mean,
+        std=std,
+        process_index=jax.process_index(),
+        process_count=jax.process_count(),
+        drop_last=False,
+    )
+    return train, val, train_ds.num_classes
+
+
+def add_common_tpu_flags(parser: argparse.ArgumentParser) -> None:
+    parser.add_argument(
+        "--model", default="mobilenetv2", choices=sorted(MODELS),
+        help="model family (reference hard-codes MobileNetV2)",
+    )
+    parser.add_argument(
+        "--steps-per-epoch", default=0, type=int,
+        help="truncate each epoch to N batches (0 = full epoch); "
+             "for smoke runs and benchmarking",
+    )
+    parser.add_argument(
+        "--log-file", default=None,
+        help="epoch log filename under ./log (reference: 512.txt)",
+    )

@@ -1,0 +1,101 @@
+"""Data-parallel CIFAR-10 training — the reference's `data_parallel.py`
+entry point, TPU-native.
+
+Reference surface (`code/distributed_training/data_parallel.py`):
+  argparse `--lr` (default 0.4) and `--resume/-r` (`:19-23`); CIFAR-10
+  batch 512 train / 1000 test (`:43-51`); MobileNetV2 wrapped in
+  `torch.nn.DataParallel` (`:74-78`); SGD(momentum .9, wd 1e-4) +
+  CosineAnnealingLR(T_max=90) + LinearWarmup(10) (`:90-96`); 100 epochs
+  with best-acc checkpointing and a txt log (`:160-172`).
+
+Here the DataParallel wrapper is a mesh: batch sharded over 'data', params
+replicated, gradients all-reduced by XLA — no scatter/replicate/
+parallel_apply/gather and no device-0 bottleneck. Run it:
+
+  python -m distributed_model_parallel_tpu.cli.data_parallel --lr 0.4
+  python -m distributed_model_parallel_tpu.cli.data_parallel --resume
+  python -m distributed_model_parallel_tpu.cli.data_parallel \
+      --dataset-type Synthetic --epochs 2 --engine ddp --sync-bn
+"""
+
+from __future__ import annotations
+
+import argparse
+
+import jax
+
+from distributed_model_parallel_tpu.cli.common import (
+    add_common_tpu_flags,
+    build_loaders,
+    build_model,
+)
+from distributed_model_parallel_tpu.parallel.data_parallel import (
+    DataParallelEngine,
+    DDPEngine,
+)
+from distributed_model_parallel_tpu.runtime.dist import initialize_backend
+from distributed_model_parallel_tpu.runtime.mesh import MeshSpec, make_mesh
+from distributed_model_parallel_tpu.training.optim import SGD
+from distributed_model_parallel_tpu.training.trainer import (
+    Trainer,
+    TrainerConfig,
+)
+
+
+def build_parser() -> argparse.ArgumentParser:
+    parser = argparse.ArgumentParser(description="TPU CIFAR10 Training")
+    # -- the reference's exact flags (`data_parallel.py:19-23`) ----------
+    parser.add_argument("--lr", default=0.4, type=float, help="learning rate")
+    parser.add_argument("--resume", "-r", action="store_true",
+                        help="resume from checkpoint")
+    # -- reference hard-codes surfaced as flags --------------------------
+    parser.add_argument("-b", "--batch-size", default=512, type=int,
+                        help="global batch size (reference: 512)")
+    parser.add_argument("--val-batch-size", default=1000, type=int)
+    parser.add_argument("--epochs", default=100, type=int)
+    parser.add_argument("-type", "--dataset-type", default="CIFAR10",
+                        dest="dataset_type")
+    parser.add_argument("--data", default="./data", help="dataset path")
+    parser.add_argument("--wd", "--weight-decay", default=1e-4, type=float,
+                        dest="weight_decay")
+    parser.add_argument("--momentum", default=0.9, type=float)
+    # -- TPU-native additions --------------------------------------------
+    parser.add_argument("--engine", default="gspmd", choices=("gspmd", "ddp"),
+                        help="gspmd: compiler-partitioned (nn.DataParallel "
+                             "equivalent); ddp: explicit shard_map psum "
+                             "(DistributedDataParallel equivalent)")
+    parser.add_argument("--sync-bn", action="store_true",
+                        help="SyncBatchNorm semantics under --engine ddp")
+    add_common_tpu_flags(parser)
+    return parser
+
+
+def main(argv=None) -> dict:
+    args = build_parser().parse_args(argv)
+    initialize_backend()
+    mesh = make_mesh(MeshSpec(data=-1))
+    train, val, num_classes = build_loaders(
+        args.dataset_type, args.data, args.batch_size,
+        val_batch_size=args.val_batch_size,
+    )
+    model = build_model(args.model, num_classes)
+    opt = SGD(momentum=args.momentum, weight_decay=args.weight_decay)
+    if args.engine == "ddp":
+        engine = DDPEngine(model, opt, mesh, sync_bn=args.sync_bn)
+    else:
+        engine = DataParallelEngine(model, opt, mesh)
+    cfg = TrainerConfig(
+        epochs=args.epochs,
+        base_lr=args.lr,
+        t_max=90,
+        warmup_period=10,
+        log_file=args.log_file or f"data_para_{args.batch_size}.txt",
+        resume=args.resume,
+        steps_per_epoch=args.steps_per_epoch,
+    )
+    trainer = Trainer(engine, train, val, cfg, rng=jax.random.PRNGKey(0))
+    return trainer.fit()
+
+
+if __name__ == "__main__":
+    main()

@@ -14,3 +14,4 @@ from distributed_model_parallel_tpu.models.resnet import (  # noqa: F401
     resnet18,
     resnet50,
 )
+from distributed_model_parallel_tpu.models.tinycnn import tiny_cnn  # noqa: F401

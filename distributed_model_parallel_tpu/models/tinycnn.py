@@ -1,0 +1,62 @@
+"""Tiny CNN — the framework's smoke/benchmark-debug model.
+
+The reference ships a `test()` smoke function that runs a random batch
+through the net and prints the shape (`code/distributed_training/model/
+mobilenetv2.py:79-83`); this is that idea promoted to a first-class zoo
+member: a 4-block conv net small enough to compile in seconds on the
+1-core CI host, with the same stem/blocks/head structure as the real
+families so every engine (DP, DDP, pipeline) and the CLI can exercise
+their full wiring cheaply.
+"""
+
+from __future__ import annotations
+
+from typing import List, Sequence
+
+from distributed_model_parallel_tpu.models import layers as L
+from distributed_model_parallel_tpu.models import staging
+
+WIDTH = 16
+N_BLOCKS = 4
+
+
+def _stem() -> L.Layer:
+    return L.sequential(
+        L.conv2d(3, WIDTH, 3, stride=1, padding=1),
+        L.batchnorm2d(WIDTH),
+        L.relu(),
+    )
+
+
+def _block(i: int) -> L.Layer:
+    stride = 2 if i == N_BLOCKS - 1 else 1
+    return L.sequential(
+        L.conv2d(WIDTH, WIDTH, 3, stride=stride, padding=1),
+        L.batchnorm2d(WIDTH),
+        L.relu(),
+    )
+
+
+def _head(num_classes: int) -> L.Layer:
+    return L.sequential(L.global_avg_pool(), L.linear(WIDTH, num_classes))
+
+
+def tiny_cnn(num_classes: int = 10) -> L.Layer:
+    return L.named([
+        ("stem", _stem()),
+        ("blocks", L.sequential(*[_block(i) for i in range(N_BLOCKS)])),
+        ("head", _head(num_classes)),
+    ])
+
+
+def split_stages(num_stages: int, num_classes: int = 10, *,
+                 boundaries: Sequence[int] | None = None) -> List[L.Layer]:
+    blocks = [_block(i) for i in range(N_BLOCKS)]
+    cuts = staging.split_points(num_stages, boundaries, len(blocks))
+    return staging.assemble_stages(blocks, _stem(), _head(num_classes), cuts)
+
+
+def partition_pytree(tree, num_stages: int, *,
+                     boundaries: Sequence[int] | None = None) -> List[dict]:
+    cuts = staging.split_points(num_stages, boundaries, N_BLOCKS)
+    return staging.partition_tree(tree, cuts)

@@ -41,6 +41,10 @@ def initialize_backend(
     global _initialized
     if _initialized:
         return
+    if coordinator_address is not None and "://" in coordinator_address:
+        # Accept reference-style URLs ('tcp://127.0.0.1:1224',
+        # `model_parallel.py:19`); jax wants bare host:port.
+        coordinator_address = coordinator_address.split("://", 1)[1]
     explicit = coordinator_address is not None
     auto = any(
         v in os.environ

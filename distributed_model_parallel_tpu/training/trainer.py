@@ -73,6 +73,9 @@ class TrainerConfig:
     checkpoint_dir: str = "./checkpoint"
     save_best: bool = True
     resume: bool = False
+    # Truncate each training epoch to N batches (0 = full epoch) — for
+    # smoke runs and throughput benchmarking.
+    steps_per_epoch: int = 0
 
 
 class Trainer:
@@ -126,6 +129,8 @@ class Trainer:
         data_time = 0.0
         epoch_start = time.perf_counter()
         while True:
+            if cfg.steps_per_epoch and n_batches >= cfg.steps_per_epoch:
+                break
             t0 = time.perf_counter()
             try:
                 images, labels = next(it)
