@@ -15,3 +15,9 @@ from distributed_model_parallel_tpu.models.resnet import (  # noqa: F401
     resnet50,
 )
 from distributed_model_parallel_tpu.models.tinycnn import tiny_cnn  # noqa: F401
+from distributed_model_parallel_tpu.models.bert import (  # noqa: F401
+    BERT_BASE,
+    BertConfig,
+    bert_base,
+    bert_for_classification,
+)

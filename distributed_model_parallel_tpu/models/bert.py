@@ -1,0 +1,181 @@
+"""BERT family — the 'BERT-base DDP' capability from BASELINE.json.
+
+Architecture follows the standard BERT encoder (post-LN, learned position
+embeddings, token-type embeddings, tanh pooler over [CLS]); numerical
+conventions (LayerNorm eps 1e-12, gelu, 0.02 init) match the torch
+`transformers.BertModel` so parity is testable weight-for-weight against
+that implementation (tests/test_bert.py transplants weights and compares
+logits).
+
+Inputs are int32 token ids (B, T), pad id 0; the attention mask is derived
+as `ids != 0` — so the whole model is a standard `Layer` and every engine
+(DP jit, DDP shard_map, pipeline) drives it exactly like the CNN zoo.
+
+Stage splitting for pipeline parallelism follows the shared staging
+convention: embeddings = stem, encoder layers = blocks, pooler+classifier
+= head.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import List, Sequence
+
+import jax
+import jax.numpy as jnp
+
+from distributed_model_parallel_tpu.models import layers as L
+from distributed_model_parallel_tpu.models import staging
+from distributed_model_parallel_tpu.models.transformer import (
+    AttentionFn,
+    encoder_layer,
+)
+from distributed_model_parallel_tpu.ops.attention import dot_product_attention
+
+
+@dataclasses.dataclass(frozen=True)
+class BertConfig:
+    vocab_size: int = 30522
+    hidden_size: int = 768
+    num_layers: int = 12
+    num_heads: int = 12
+    intermediate_size: int = 3072
+    max_position: int = 512
+    type_vocab_size: int = 2
+    dropout_rate: float = 0.1
+    layer_norm_eps: float = 1e-12
+    pad_token_id: int = 0
+
+
+BERT_BASE = BertConfig()
+
+
+def _embeddings(cfg: BertConfig) -> L.Layer:
+    """word + position + token-type embeddings, LN, dropout. Input: int ids
+    (B, T) (token-type ids all zero — single-segment; the classification
+    surface this framework benchmarks). Output: (hidden, mask)."""
+    ln = L.layernorm(cfg.hidden_size, eps=cfg.layer_norm_eps)
+    drop = L.dropout(cfg.dropout_rate)
+
+    def init(key):
+        kw, kp, kt, kl = jax.random.split(key, 4)
+        params = {
+            "word": 0.02 * jax.random.normal(
+                kw, (cfg.vocab_size, cfg.hidden_size)
+            ),
+            "position": 0.02 * jax.random.normal(
+                kp, (cfg.max_position, cfg.hidden_size)
+            ),
+            "token_type": 0.02 * jax.random.normal(
+                kt, (cfg.type_vocab_size, cfg.hidden_size)
+            ),
+            "ln": ln.init(kl)[0],
+        }
+        return params, {}
+
+    def apply(params, state, ids, ctx):
+        t = ids.shape[1]
+        mask = ids != cfg.pad_token_id
+        h = (
+            jnp.take(params["word"], ids, axis=0)
+            + params["position"][None, :t, :]
+            + params["token_type"][0][None, None, :]
+        )
+        h, _ = ln.apply(params["ln"], {}, h, ctx)
+        h, _ = drop.apply({}, {}, h, ctx)
+        return (h, mask), state
+
+    return L.Layer(init, apply)
+
+
+def _encoder_blocks(
+    cfg: BertConfig, attention_fn: AttentionFn
+) -> List[L.Layer]:
+    return [
+        encoder_layer(
+            cfg.hidden_size,
+            cfg.num_heads,
+            cfg.intermediate_size,
+            dropout_rate=cfg.dropout_rate,
+            eps=cfg.layer_norm_eps,
+            attention_fn=attention_fn,
+        )
+        for _ in range(cfg.num_layers)
+    ]
+
+
+def _cls_head(cfg: BertConfig, num_classes: int) -> L.Layer:
+    """tanh pooler over [CLS] + classifier; takes (hidden, mask)."""
+
+    def init(key):
+        kp, kc = jax.random.split(key)
+        return {
+            "pooler": {
+                "w": 0.02 * jax.random.normal(
+                    kp, (cfg.hidden_size, cfg.hidden_size)
+                ),
+                "b": jnp.zeros((cfg.hidden_size,)),
+            },
+            "classifier": {
+                "w": 0.02 * jax.random.normal(
+                    kc, (cfg.hidden_size, num_classes)
+                ),
+                "b": jnp.zeros((num_classes,)),
+            },
+        }, {}
+
+    def apply(params, state, x, ctx):
+        h, _ = x
+        pooled = jnp.tanh(
+            h[:, 0, :] @ params["pooler"]["w"] + params["pooler"]["b"]
+        )
+        logits = pooled @ params["classifier"]["w"] + params["classifier"]["b"]
+        return logits, state
+
+    return L.Layer(init, apply)
+
+
+def bert_for_classification(
+    num_classes: int = 2,
+    cfg: BertConfig = BERT_BASE,
+    *,
+    attention_fn: AttentionFn = dot_product_attention,
+) -> L.Layer:
+    """Full classification model: int ids (B, T) -> logits (B, C)."""
+    return L.named([
+        ("stem", _embeddings(cfg)),
+        ("blocks", L.sequential(*_encoder_blocks(cfg, attention_fn))),
+        ("head", _cls_head(cfg, num_classes)),
+    ])
+
+
+def bert_base(num_classes: int = 2) -> L.Layer:
+    return bert_for_classification(num_classes, BERT_BASE)
+
+
+def split_stages(
+    num_stages: int,
+    num_classes: int = 2,
+    cfg: BertConfig = BERT_BASE,
+    *,
+    boundaries: Sequence[int] | None = None,
+    attention_fn: AttentionFn = dot_product_attention,
+) -> List[L.Layer]:
+    """Pipeline stages: embeddings on stage 0, encoder layers distributed,
+    pooler+classifier on the last stage (shared staging convention)."""
+    blocks = _encoder_blocks(cfg, attention_fn)
+    cuts = staging.split_points(num_stages, boundaries, len(blocks))
+    return staging.assemble_stages(
+        blocks, _embeddings(cfg), _cls_head(cfg, num_classes), cuts
+    )
+
+
+def partition_pytree(
+    tree,
+    num_stages: int,
+    cfg: BertConfig = BERT_BASE,
+    *,
+    boundaries: Sequence[int] | None = None,
+) -> List[dict]:
+    cuts = staging.split_points(num_stages, boundaries, cfg.num_layers)
+    return staging.partition_tree(tree, cuts)

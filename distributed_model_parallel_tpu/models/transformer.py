@@ -1,0 +1,135 @@
+"""Transformer encoder building blocks (BERT-style post-LN).
+
+The reference has no attention model; these layers exist because
+BASELINE.json's configs demand 'BERT-base DDP' and the framework treats
+long-sequence models as first-class. Encoder layers operate on a
+`(hidden, mask)` pair — the mask (B, T) bool rides alongside the hidden
+states through `sequential`, which keeps the stack splittable into
+pipeline stages exactly like the CNN families.
+
+Attention math routes through `ops.attention.dot_product_attention`, the
+swap point for ring attention ('seq'-sharded KV rotation) and the Pallas
+flash kernel. Head-dimension projections are single fused (D, 3D)/(D, D)
+matmuls — the layout tensor parallelism shards on the 'model' axis.
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+
+from distributed_model_parallel_tpu.models import layers as L
+from distributed_model_parallel_tpu.ops.attention import dot_product_attention
+
+AttentionFn = Callable[..., jax.Array]
+
+
+def _linear_params(key, d_in, d_out, scale=0.02):
+    wkey, _ = jax.random.split(key)
+    return {
+        "w": scale * jax.random.normal(wkey, (d_in, d_out)),
+        "b": jnp.zeros((d_out,)),
+    }
+
+
+def multi_head_attention(
+    dim: int,
+    num_heads: int,
+    *,
+    dropout_rate: float = 0.0,
+    attention_fn: AttentionFn = dot_product_attention,
+) -> L.Layer:
+    """Self-attention over (hidden, mask): fused QKV projection, per-head
+    scaled dot-product via `attention_fn`, output projection."""
+    if dim % num_heads:
+        raise ValueError(f"dim {dim} not divisible by num_heads {num_heads}")
+    dh = dim // num_heads
+    drop = L.dropout(dropout_rate)
+
+    def init(key):
+        kqkv, kout = jax.random.split(key)
+        return {
+            "qkv": _linear_params(kqkv, dim, 3 * dim),
+            "out": _linear_params(kout, dim, dim),
+        }, {}
+
+    def apply(params, state, x, ctx):
+        h, mask = x
+        b, t, _ = h.shape
+        qkv = h @ params["qkv"]["w"] + params["qkv"]["b"]
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+        q = q.reshape(b, t, num_heads, dh)
+        k = k.reshape(b, t, num_heads, dh)
+        v = v.reshape(b, t, num_heads, dh)
+        o = attention_fn(q, k, v, mask)
+        o = o.reshape(b, t, dim) @ params["out"]["w"] + params["out"]["b"]
+        o, _ = drop.apply({}, {}, o, ctx)
+        return (o, mask), state
+
+    return L.Layer(init, apply)
+
+
+def feed_forward(
+    dim: int, hidden_dim: int, *, dropout_rate: float = 0.0
+) -> L.Layer:
+    """Position-wise FFN (dense -> gelu -> dense) on (hidden, mask)."""
+    drop = L.dropout(dropout_rate)
+
+    def init(key):
+        k1, k2 = jax.random.split(key)
+        return {
+            "in": _linear_params(k1, dim, hidden_dim),
+            "out": _linear_params(k2, hidden_dim, dim),
+        }, {}
+
+    def apply(params, state, x, ctx):
+        h, mask = x
+        y = jax.nn.gelu(h @ params["in"]["w"] + params["in"]["b"],
+                        approximate=False)
+        y = y @ params["out"]["w"] + params["out"]["b"]
+        y, _ = drop.apply({}, {}, y, ctx)
+        return (y, mask), state
+
+    return L.Layer(init, apply)
+
+
+def encoder_layer(
+    dim: int,
+    num_heads: int,
+    hidden_dim: int,
+    *,
+    dropout_rate: float = 0.0,
+    eps: float = 1e-12,
+    attention_fn: AttentionFn = dot_product_attention,
+) -> L.Layer:
+    """BERT post-LN block: LN(h + Attn(h)); LN(h + FFN(h))."""
+    attn = multi_head_attention(
+        dim, num_heads, dropout_rate=dropout_rate, attention_fn=attention_fn
+    )
+    ffn = feed_forward(dim, hidden_dim, dropout_rate=dropout_rate)
+    ln1 = L.layernorm(dim, eps=eps)
+    ln2 = L.layernorm(dim, eps=eps)
+
+    def init(key):
+        ka, kf, k1, k2 = jax.random.split(key, 4)
+        return (
+            {
+                "attn": attn.init(ka)[0],
+                "ln1": ln1.init(k1)[0],
+                "ffn": ffn.init(kf)[0],
+                "ln2": ln2.init(k2)[0],
+            },
+            {},
+        )
+
+    def apply(params, state, x, ctx):
+        h, mask = x
+        (a, _), _ = attn.apply(params["attn"], {}, (h, mask), ctx.child(0))
+        h, _ = ln1.apply(params["ln1"], {}, h + a, ctx)
+        (f, _), _ = ffn.apply(params["ffn"], {}, (h, mask), ctx.child(1))
+        h, _ = ln2.apply(params["ln2"], {}, h + f, ctx)
+        return (h, mask), state
+
+    return L.Layer(init, apply)

@@ -1,0 +1,3 @@
+"""Compute ops: attention cores (reference-free — the reference has no
+attention model; BERT-base is demanded by BASELINE.json's configs), and
+Pallas TPU kernels for the hot paths."""

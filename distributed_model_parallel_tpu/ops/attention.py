@@ -1,0 +1,52 @@
+"""Scaled dot-product attention cores.
+
+The plain XLA version lives here as the numerical reference and CPU/test
+path; it is written so the sequence-parallel engines can swap in ring
+attention (KV rotating over the 'seq' axis) or a Pallas flash kernel
+without touching the transformer layers: everything routes through
+`dot_product_attention(q, k, v, mask)`.
+
+Shapes follow the TPU-friendly convention (B, T, H, Dh) — batch, sequence,
+heads, head_dim — so the head axis is adjacent to the feature axis XLA
+tiles onto the MXU, and sequence sharding (ring attention / Ulysses) maps
+onto axis 1 without transposes.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+
+def dot_product_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    mask: Optional[jax.Array] = None,
+    *,
+    scale: Optional[float] = None,
+) -> jax.Array:
+    """softmax(q k^T / sqrt(dh)) v over (B, T, H, Dh) tensors.
+
+    `mask`: boolean (B, Tkv) key-validity mask (True = attend) or a
+    broadcastable additive-logit-compatible boolean of shape
+    (B, 1|H, Tq, Tkv). Computation in f32 regardless of input dtype
+    (softmax stability on bf16 inputs), result cast back.
+    """
+    dh = q.shape[-1]
+    scale = scale if scale is not None else 1.0 / jnp.sqrt(dh).astype(
+        jnp.float32
+    )
+    qf = q.astype(jnp.float32)
+    kf = k.astype(jnp.float32)
+    # (B, H, Tq, Tkv)
+    logits = jnp.einsum("bqhd,bkhd->bhqk", qf, kf) * scale
+    if mask is not None:
+        if mask.ndim == 2:  # (B, Tkv) key mask
+            mask = mask[:, None, None, :]
+        logits = jnp.where(mask, logits, jnp.finfo(jnp.float32).min)
+    weights = jax.nn.softmax(logits, axis=-1)
+    out = jnp.einsum("bhqk,bkhd->bqhd", weights, v.astype(jnp.float32))
+    return out.astype(q.dtype)

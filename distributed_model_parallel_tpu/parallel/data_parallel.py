@@ -99,9 +99,13 @@ class DataParallelEngine:
         self._batch = NamedSharding(mesh, P(("data",)))
 
         def train_step(ts: TrainState, images, labels, lr):
+            # Deterministic per-step dropout key (global batch => one key;
+            # the partitioner shards the mask with the activations).
+            rng = jax.random.fold_in(jax.random.PRNGKey(0), ts.step)
+
             def loss_fn(params, model_state):
                 logits, new_state = self.model.apply(
-                    params, model_state, images, Context(train=True)
+                    params, model_state, images, Context(train=True, rng=rng)
                 )
                 loss = cross_entropy(logits, labels)
                 return loss, (new_state, logits)
@@ -185,10 +189,18 @@ class DDPEngine:
             check_vma=False,
         )
         def shard_step(ts: TrainState, images, labels, lr):
+            # Per-shard dropout key: fold in the data-axis index so every
+            # replica draws independent masks (per-replica semantics, like
+            # the reference's per-device threads).
+            rng = jax.random.fold_in(
+                jax.random.fold_in(jax.random.PRNGKey(0), ts.step),
+                lax.axis_index("data"),
+            )
+
             def loss_fn(params, model_state):
                 logits, new_state = self.model.apply(
                     params, model_state, images,
-                    Context(train=True, bn_axis=bn_axis),
+                    Context(train=True, bn_axis=bn_axis, rng=rng),
                 )
                 loss = cross_entropy(logits, labels)
                 return loss, (new_state, logits)

@@ -71,8 +71,36 @@ from distributed_model_parallel_tpu.training.metrics import (
 from distributed_model_parallel_tpu.training.optim import SGD
 
 
-def _flat_size(shape: Sequence[int]) -> int:
-    return math.prod(shape)
+def _tree_size(aval_tree) -> int:
+    """Total element count of a pytree of avals/arrays."""
+    return sum(
+        math.prod(leaf.shape)
+        for leaf in jax.tree_util.tree_leaves(aval_tree)
+    )
+
+
+def _pack(tree, buf_size: int) -> jax.Array:
+    """Pytree of arrays -> one flat f32 buffer padded to `buf_size` (the
+    wire format between stages; one static ppermute shape for everything)."""
+    flats = [
+        leaf.astype(jnp.float32).reshape(-1)
+        for leaf in jax.tree_util.tree_leaves(tree)
+    ]
+    flat = jnp.concatenate(flats) if len(flats) > 1 else flats[0]
+    return jnp.zeros((buf_size,), jnp.float32).at[: flat.shape[0]].set(flat)
+
+
+def _unpack(buf: jax.Array, aval_tree):
+    """Inverse of `_pack` given the target aval pytree."""
+    leaves, treedef = jax.tree_util.tree_flatten(aval_tree)
+    out, offset = [], 0
+    for leaf in leaves:
+        n = math.prod(leaf.shape)
+        out.append(
+            buf[offset:offset + n].reshape(leaf.shape).astype(leaf.dtype)
+        )
+        offset += n
+    return jax.tree_util.tree_unflatten(treedef, out)
 
 
 @dataclasses.dataclass
@@ -127,23 +155,23 @@ class PipelineEngine:
     def shard_batch(self, images, labels):
         return _place_batch((images, labels), self._batch)
 
-    def _stage_shapes(
-        self, params, state, x_shape, dtype, train: bool
-    ) -> List[Tuple[Tuple[int, ...], Tuple[int, ...]]]:
-        """(input_shape, output_shape) per stage from an abstract trace —
+    def _stage_avals(self, params, state, x_aval, train: bool):
+        """(input_avals, output_avals) per stage from an abstract trace —
         the static replacement for the reference's runtime dim/size
-        handshake (`distributed_layers.py:40-47`)."""
+        handshake (`distributed_layers.py:40-47`). Stage I/O may be any
+        pytree of arrays (e.g. BERT's (hidden, mask) pair); everything
+        crosses stages packed into one flat f32 buffer."""
         ctx = Context(train=train)
-        aval = jax.ShapeDtypeStruct(tuple(x_shape), dtype)
-        shapes = []
+        aval = x_aval
+        avals = []
         for i, stage in enumerate(self.stages):
             out = jax.eval_shape(
                 lambda p, s, x, stage=stage: stage.apply(p, s, x, ctx)[0],
                 params[i], state[i], aval,
             )
-            shapes.append((tuple(aval.shape), tuple(out.shape)))
-            aval = jax.ShapeDtypeStruct(tuple(out.shape), dtype)
-        return shapes
+            avals.append((aval, out))
+            aval = out
+        return avals
 
     # ------------------------------------------------------- the program
 
@@ -153,7 +181,7 @@ class PipelineEngine:
         mesh = self.mesh
         bn_axis = "data" if self.sync_bn else None
 
-        def pipeline_forward(params, model_state, images, labels):
+        def pipeline_forward(params, model_state, images, labels, step):
             """Runs on ONE device (inside shard_map): the full fill-drain
             schedule for this device's stage. Returns (sum CE over local
             batch, logits for the local batch, updated state)."""
@@ -164,32 +192,34 @@ class PipelineEngine:
                     f"num_microbatches {M}"
                 )
             mb = n_local // M
-            shapes = self._stage_shapes(
-                params, model_state, (mb,) + images.shape[1:],
-                images.dtype, train,
+            x_aval = jax.ShapeDtypeStruct(
+                (mb,) + images.shape[1:], images.dtype
             )
-            num_classes = shapes[-1][1][-1]
-            buf_size = max(_flat_size(out) for _, out in shapes)
+            avals = self._stage_avals(params, model_state, x_aval, train)
+            out_leaves = jax.tree_util.tree_leaves(avals[-1][1])
+            if len(out_leaves) != 1 or len(out_leaves[0].shape) != 2:
+                raise ValueError(
+                    "last pipeline stage must output a single (batch, "
+                    f"classes) logits array, got {avals[-1][1]}"
+                )
+            num_classes = out_leaves[0].shape[-1]
+            buf_size = max(_tree_size(out) for _, out in avals)
             s_idx = lax.axis_index("stage")
 
-            ctx = Context(train=train, bn_axis=bn_axis)
-
             def make_branch(i):
-                in_shape = shapes[i][0]
+                in_aval = avals[i][0]
 
                 def branch(operand):
-                    state, buf, images_mb = operand
+                    state, buf, images_mb, rng = operand
+                    ctx = Context(train=train, bn_axis=bn_axis, rng=rng)
                     if i == 0:
                         x = images_mb
                     else:
-                        x = buf[: _flat_size(in_shape)].reshape(in_shape)
+                        x = _unpack(buf, in_aval)
                     y, new_si = self.stages[i].apply(
                         params[i], state[i], x, ctx
                     )
-                    y_flat = y.reshape(-1)
-                    y_pad = jnp.zeros((buf_size,), y_flat.dtype).at[
-                        : y_flat.shape[0]
-                    ].set(y_flat)
+                    y_pad = _pack(y, buf_size)
                     new_state = tuple(
                         new_si if j == i else state[j] for j in range(S)
                     )
@@ -199,6 +229,10 @@ class PipelineEngine:
 
             branches = [make_branch(i) for i in range(S)]
             images_mbs = images.reshape((M, mb) + images.shape[1:])
+            rng_base = jax.random.fold_in(
+                jax.random.fold_in(jax.random.PRNGKey(0), step),
+                lax.axis_index("data"),
+            )
 
             def tick(carry, t):
                 buf, state, out_stack = carry
@@ -208,8 +242,13 @@ class PipelineEngine:
                 images_mb = lax.dynamic_index_in_dim(
                     images_mbs, m_safe, keepdims=False
                 )
+                # Per-(stage, microbatch) dropout key: every stage draws
+                # independent masks for each microbatch of this step.
+                rng = jax.random.fold_in(
+                    jax.random.fold_in(rng_base, s_idx), m_safe
+                )
                 y_pad, new_state = lax.switch(
-                    s_idx, branches, (state, buf, images_mb)
+                    s_idx, branches, (state, buf, images_mb, rng)
                 )
                 # Mask bubble ticks: keep old BN stats, zero the output so
                 # garbage never reaches the logits stack.
@@ -235,8 +274,8 @@ class PipelineEngine:
                     )
                 return (buf, state, out_stack), None
 
-            buf0 = jnp.zeros((buf_size,), images.dtype)
-            out0 = jnp.zeros((M, mb, num_classes), images.dtype)
+            buf0 = jnp.zeros((buf_size,), jnp.float32)
+            out0 = jnp.zeros((M, mb, num_classes), jnp.float32)
             (buf, new_state, out_stack), _ = lax.scan(
                 tick,
                 (buf0, model_state, out0),
@@ -294,7 +333,7 @@ class PipelineEngine:
 
                 def loss_fn(params):
                     loss_sum, aux = pipeline_forward(
-                        params, ts.model_state, images, labels
+                        params, ts.model_state, images, labels, ts.step
                     )
                     return loss_sum / images.shape[0], aux
 
@@ -331,7 +370,7 @@ class PipelineEngine:
         )
         def evstep(ts: TrainState, images, labels):
             loss_sum, (logits, _, is_last) = pipeline_forward(
-                ts.params, ts.model_state, images, labels
+                ts.params, ts.model_state, images, labels, ts.step
             )
             return metrics_from(logits, labels, loss_sum, is_last)
 
