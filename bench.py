@@ -6,63 +6,224 @@ reference's only published performance table: `nn.DataParallel`, batch 512,
 0.396 s/batch on 4 GPUs = 1292.9 images/sec (`Readme.md:283-287`,
 SURVEY.md §6). `vs_baseline` is our images/sec divided by that number.
 
-Runs on whatever devices are present (one real TPU chip under the driver;
-the virtual CPU mesh if JAX_PLATFORMS=cpu is forced).
+Hardened after round 1 (VERDICT.md "What's weak" #3: one backend-init
+failure -> rc=1, no JSON at all):
+* The remote TPU backend is probed in a SUBPROCESS with a timeout and one
+  retry — backend init on this image can block for minutes when the device
+  tunnel is down, and an in-process probe could never be cancelled. A probe
+  that comes back reporting the cpu platform counts as NO accelerator.
+* If no accelerator comes up, the benchmark falls back to the virtual-CPU
+  mesh with a model that compiles in seconds there, and the JSON line says
+  so (`platform: cpu`) instead of crashing.
+* A SIGALRM watchdog bounds total runtime (both modes); on expiry a
+  diagnostic JSON line is emitted and the exit code is still 0.
+
+`--scaling` sweeps the 'data' mesh axis over virtual CPU devices and
+prints an images/sec/chip weak-scaling table (BASELINE.json north-star
+shape) instead of the single line.
 """
 
 from __future__ import annotations
 
+import argparse
 import json
+import os
+import signal
+import subprocess
+import sys
 import time
 
-import jax
-import jax.numpy as jnp
-import numpy as np
-
-from distributed_model_parallel_tpu.models.mobilenetv2 import mobilenet_v2
-from distributed_model_parallel_tpu.parallel.data_parallel import DataParallelEngine
-from distributed_model_parallel_tpu.runtime.mesh import MeshSpec, make_mesh
-from distributed_model_parallel_tpu.training.optim import SGD
+from distributed_model_parallel_tpu.runtime.platform import force_cpu
 
 # Reference: DP 0.396 s/batch @ global batch 512 on 4 GPUs (Readme.md:283-287).
 BASELINE_IMG_PER_SEC = 512 / 0.396
 
-BATCH = 512
-WARMUP = 5
-ITERS = 30
+METRIC = "mobilenetv2_cifar10_dp_train_throughput"
+TOTAL_BUDGET_S = int(os.environ.get("BENCH_TIMEOUT_S", "540"))
+
+
+def emit(value: float, unit: str, vs_baseline: float, **extra) -> None:
+    print(json.dumps({
+        "metric": METRIC,
+        "value": round(value, 1),
+        "unit": unit,
+        "vs_baseline": round(vs_baseline, 3),
+        **extra,
+    }), flush=True)
+
+
+def accelerator_available(timeout_s: int = 150, attempts: int = 2) -> bool:
+    """True iff `jax.devices()` on the default (tunneled TPU) platform
+    initializes within `timeout_s` AND reports a non-cpu platform. Probed
+    out-of-process so a hung dial can be killed; jax falling back to its
+    CPU backend is counted as no accelerator (running the full-size
+    benchmark on CPU would only hit the watchdog)."""
+    probe = "import jax; print(jax.devices()[0].platform)"
+    for i in range(attempts):
+        try:
+            out = subprocess.run(
+                [sys.executable, "-c", probe],
+                capture_output=True, text=True, timeout=timeout_s,
+            )
+            platform = out.stdout.strip().lower()
+            if out.returncode == 0 and platform and platform != "cpu":
+                return True
+        except subprocess.TimeoutExpired:
+            pass
+        if i + 1 < attempts:
+            time.sleep(5 * (i + 1))
+    return False
+
+
+def _timed_step_loop(engine, state, images, labels, lr, warmup, iters):
+    """Fenced throughput measurement: returns seconds for `iters` steps
+    after `warmup` compile/warm steps."""
+    import jax
+
+    for _ in range(warmup):
+        state, _ = engine.train_step(state, images, labels, lr)
+    jax.block_until_ready(state)
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        state, _ = engine.train_step(state, images, labels, lr)
+    jax.block_until_ready(state)
+    return time.perf_counter() - t0
+
+
+def _fake_batch(batch: int, seed: int = 0):
+    import numpy as np
+
+    rng = np.random.RandomState(seed)
+    images = rng.rand(batch, 32, 32, 3).astype(np.float32)
+    labels = rng.randint(0, 10, size=(batch,)).astype(np.int32)
+    return images, labels
+
+
+def run_throughput(model_name: str, batch: int, warmup: int, iters: int):
+    """(images/sec, platform) for a DP train step on the current devices."""
+    import jax
+    import jax.numpy as jnp
+
+    from distributed_model_parallel_tpu.models.mobilenetv2 import mobilenet_v2
+    from distributed_model_parallel_tpu.models.tinycnn import tiny_cnn
+    from distributed_model_parallel_tpu.parallel.data_parallel import (
+        DataParallelEngine,
+    )
+    from distributed_model_parallel_tpu.runtime.mesh import MeshSpec, make_mesh
+    from distributed_model_parallel_tpu.training.optim import SGD
+
+    model = {"mobilenetv2": mobilenet_v2, "tinycnn": tiny_cnn}[model_name](10)
+    mesh = make_mesh(MeshSpec(data=-1))
+    engine = DataParallelEngine(model=model, optimizer=SGD(), mesh=mesh)
+    state = engine.init_state(jax.random.PRNGKey(0))
+    images, labels = engine.shard_batch(*_fake_batch(batch))
+    dt = _timed_step_loop(
+        engine, state, images, labels, jnp.float32(0.2), warmup, iters
+    )
+    return batch * iters / dt, jax.devices()[0].platform
 
 
 def main() -> None:
-    mesh = make_mesh(MeshSpec(data=-1))
-    engine = DataParallelEngine(
-        model=mobilenet_v2(10), optimizer=SGD(), mesh=mesh
-    )
-    state = engine.init_state(jax.random.PRNGKey(0))
+    try:
+        if accelerator_available():
+            img_per_sec, platform = run_throughput(
+                "mobilenetv2", batch=512, warmup=5, iters=30
+            )
+            emit(
+                img_per_sec, "images/sec",
+                img_per_sec / BASELINE_IMG_PER_SEC, platform=platform,
+            )
+        else:
+            # No accelerator: degrade, don't crash. The tiny model exists
+            # because full MobileNetV2 takes ~10 min to COMPILE on a
+            # 1-core CPU host; a diagnostic number from the same
+            # engine/collective path is better than rc=1.
+            force_cpu()
+            img_per_sec, platform = run_throughput(
+                "tinycnn", batch=256, warmup=2, iters=10
+            )
+            emit(
+                img_per_sec, "images/sec", 0.0, platform=platform,
+                error="accelerator unavailable; tinycnn on virtual-CPU mesh",
+            )
+    except Exception as e:  # noqa: BLE001 — the contract is one JSON line, rc 0
+        emit(0.0, "images/sec", 0.0, error=f"{type(e).__name__}: {e}")
 
-    rng = np.random.RandomState(0)
-    images = rng.rand(BATCH, 32, 32, 3).astype(np.float32)
-    labels = rng.randint(0, 10, size=(BATCH,)).astype(np.int32)
-    images, labels = engine.shard_batch(images, labels)
-    lr = jnp.float32(0.2)
 
-    for _ in range(WARMUP):
-        state, metrics = engine.train_step(state, images, labels, lr)
-    jax.block_until_ready(state)
+def scaling_table(max_devices: int = 8) -> None:
+    """Weak-scaling sweep over the 'data' axis on virtual CPU devices:
+    images/sec/chip and efficiency vs N=1 (BASELINE.json north-star shape).
+    Per-chip batch is held constant (weak scaling)."""
+    if max_devices < 1:
+        raise ValueError(f"--max-devices must be >= 1, got {max_devices}")
+    force_cpu(max_devices)
 
-    t0 = time.perf_counter()
-    for _ in range(ITERS):
-        state, metrics = engine.train_step(state, images, labels, lr)
-    jax.block_until_ready(state)
-    dt = time.perf_counter() - t0
+    import jax
+    import jax.numpy as jnp
 
-    img_per_sec = BATCH * ITERS / dt
-    print(json.dumps({
-        "metric": "mobilenetv2_cifar10_dp_train_throughput",
-        "value": round(img_per_sec, 1),
-        "unit": "images/sec",
-        "vs_baseline": round(img_per_sec / BASELINE_IMG_PER_SEC, 3),
-    }))
+    from distributed_model_parallel_tpu.models.tinycnn import tiny_cnn
+    from distributed_model_parallel_tpu.parallel.data_parallel import DDPEngine
+    from distributed_model_parallel_tpu.runtime.mesh import MeshSpec, make_mesh
+    from distributed_model_parallel_tpu.training.optim import SGD
+
+    per_chip_batch = 64
+    sizes = []
+    n = 1
+    while n <= max_devices:
+        sizes.append(n)
+        n *= 2
+    if sizes[-1] != max_devices:
+        sizes.append(max_devices)  # non-power-of-two cap still measured
+
+    rows = []
+    for n in sizes:
+        mesh = make_mesh(MeshSpec(data=n), devices=jax.devices("cpu")[:n])
+        engine = DDPEngine(model=tiny_cnn(10), optimizer=SGD(), mesh=mesh)
+        state = engine.init_state(jax.random.PRNGKey(0))
+        batch = per_chip_batch * n
+        images, labels = engine.shard_batch(*_fake_batch(batch))
+        dt = _timed_step_loop(
+            engine, state, images, labels, jnp.float32(0.1),
+            warmup=2, iters=10,
+        )
+        per_chip = batch * 10 / dt / n
+        rows.append({"chips": n, "img_per_sec_per_chip": round(per_chip, 1)})
+    base = rows[0]["img_per_sec_per_chip"]
+    for r in rows:
+        r["weak_scaling_efficiency"] = round(
+            r["img_per_sec_per_chip"] / base, 3
+        )
+    out = {"scaling": rows}
+    if jax.devices()[0].platform == "cpu":
+        out["note"] = (
+            "virtual CPU devices share one host core, so per-chip "
+            "throughput necessarily drops ~1/N here; the harness is "
+            "meaningful on real chips, where each mesh slot has its own "
+            "silicon"
+        )
+    print(json.dumps(out, indent=2))
 
 
 if __name__ == "__main__":
-    main()
+    parser = argparse.ArgumentParser()
+    parser.add_argument(
+        "--scaling", action="store_true",
+        help="print a virtual-device weak-scaling table instead of the "
+             "single benchmark line",
+    )
+    parser.add_argument("--max-devices", type=int, default=8)
+    args = parser.parse_args()
+
+    def on_alarm(signum, frame):
+        emit(0.0, "images/sec", 0.0, error="bench watchdog expired")
+        os._exit(0)
+
+    signal.signal(signal.SIGALRM, on_alarm)
+    signal.alarm(TOTAL_BUDGET_S)
+    try:
+        if args.scaling:
+            scaling_table(args.max_devices)
+        else:
+            main()
+    except Exception as e:  # noqa: BLE001 — rc must stay 0 with a JSON line
+        emit(0.0, "images/sec", 0.0, error=f"{type(e).__name__}: {e}")

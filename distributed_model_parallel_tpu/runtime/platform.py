@@ -1,0 +1,39 @@
+"""Platform forcing helpers shared by the bench/dryrun entry points.
+
+This image's sitecustomize imports jax at interpreter start (registering a
+remote TPU PJRT plugin), so jax's config captures JAX_PLATFORMS before any
+user code runs; mutating os.environ afterwards does nothing. The only
+reliable switch is `jax.config.update("jax_platforms", ...)` — and the
+virtual-device XLA flag must be in the environment before the CPU client
+first initializes or it is silently ignored.
+"""
+
+from __future__ import annotations
+
+import os
+
+
+def force_cpu(n_devices: int = 8):
+    """Force the cpu platform with >= n_devices virtual devices; returns
+    the device list. Safe to call before or after `import jax`, but only
+    before the CPU backend's first initialization."""
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + f" --xla_force_host_platform_device_count={n_devices}"
+        ).strip()
+    os.environ["JAX_PLATFORMS"] = "cpu"
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    devices = jax.devices()
+    if len(devices) < n_devices:
+        devices = jax.devices("cpu")
+    if len(devices) < n_devices:
+        raise RuntimeError(
+            f"need {n_devices} devices, found {len(devices)} "
+            f"(platform {devices[0].platform}); was the CPU backend "
+            "initialized before force_cpu()?"
+        )
+    return devices[:n_devices]
