@@ -76,12 +76,27 @@ def build_loaders(
     seed: int = 0,
 ):
     """(train_loader, val_loader, num_classes) with per-host sharding —
-    the DistributedSampler the reference lacks (`utils.py:21`)."""
+    the DistributedSampler the reference lacks (`utils.py:21`).
+
+    `batch_size` / `val_batch_size` are GLOBAL batch sizes (the reference's
+    `-b 512` means 512 total, and lr=0.4 is tuned to that); each host's
+    Loader draws global/process_count samples per step."""
+    procs = jax.process_count()
+    if batch_size % procs:
+        raise SystemExit(
+            f"global batch size {batch_size} must be divisible by the "
+            f"process count {procs}"
+        )
+    if val_batch_size is not None and val_batch_size % procs:
+        raise SystemExit(
+            f"global val batch size {val_batch_size} must be divisible by "
+            f"the process count {procs}"
+        )
     train_ds, val_ds = DatasetCollection(dataset_type, data_path).init()
     mean, std = stats_for(dataset_type)
     train = Loader(
         train_ds,
-        batch_size=batch_size,
+        batch_size=batch_size // procs,
         shuffle=True,
         augment=augment,
         mean=mean,
@@ -92,7 +107,7 @@ def build_loaders(
     )
     val = Loader(
         val_ds,
-        batch_size=val_batch_size or batch_size,
+        batch_size=(val_batch_size or batch_size) // procs,
         shuffle=False,
         augment=False,
         mean=mean,
@@ -102,6 +117,27 @@ def build_loaders(
         drop_last=False,
     )
     return train, val, train_ds.num_classes
+
+
+def check_batch_divisibility(
+    global_batch: int, mesh, *, microbatches: int = 1, label: str = "batch"
+) -> None:
+    """Fail at startup (not at trace time, possibly an epoch in) when the
+    batch cannot be laid out on the mesh: the global batch shards over the
+    'data' axis, and each device's shard must split into `microbatches`
+    equal microbatches for the pipeline schedule."""
+    data_axis = mesh.shape["data"]
+    if global_batch % data_axis:
+        raise SystemExit(
+            f"{label} size {global_batch} must be divisible by the 'data' "
+            f"mesh axis ({data_axis} shards)"
+        )
+    local = global_batch // data_axis
+    if local % microbatches:
+        raise SystemExit(
+            f"{label} size {global_batch} gives {local} samples per 'data' "
+            f"shard, not divisible by --microbatches {microbatches}"
+        )
 
 
 def add_common_tpu_flags(parser: argparse.ArgumentParser) -> None:

@@ -28,6 +28,7 @@ from distributed_model_parallel_tpu.cli.common import (
     add_common_tpu_flags,
     build_loaders,
     build_model,
+    check_batch_divisibility,
 )
 from distributed_model_parallel_tpu.parallel.data_parallel import (
     DataParallelEngine,
@@ -74,6 +75,8 @@ def main(argv=None) -> dict:
     args = build_parser().parse_args(argv)
     initialize_backend()
     mesh = make_mesh(MeshSpec(data=-1))
+    check_batch_divisibility(args.batch_size, mesh)
+    check_batch_divisibility(args.val_batch_size, mesh, label="val batch")
     train, val, num_classes = build_loaders(
         args.dataset_type, args.data, args.batch_size,
         val_batch_size=args.val_batch_size,

@@ -30,6 +30,7 @@ from distributed_model_parallel_tpu.cli.common import (
     STAGE_BUILDERS,
     add_common_tpu_flags,
     build_loaders,
+    check_batch_divisibility,
 )
 from distributed_model_parallel_tpu.parallel.pipeline import PipelineEngine
 from distributed_model_parallel_tpu.runtime.dist import initialize_backend
@@ -97,6 +98,9 @@ def main(argv=None) -> dict:
     args = build_parser().parse_args(argv)
     initialize_backend(coordinator_address=args.dist_url)
     mesh = make_mesh(MeshSpec(data=-1, stage=args.world_size))
+    check_batch_divisibility(
+        args.batch_size, mesh, microbatches=args.microbatches
+    )
     train, val, num_classes = build_loaders(
         args.dataset_type, args.data, args.batch_size,
     )

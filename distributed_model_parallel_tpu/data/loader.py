@@ -59,7 +59,13 @@ class Loader:
     `process_index/process_count` implement the missing DistributedSampler:
     after the global epoch shuffle (seeded by epoch, identical on all
     hosts), each host takes every `process_count`-th index. `drop_last` is
-    forced on for training so batch shapes are static for XLA.
+    forced on for training so batch shapes are static for XLA; with
+    `drop_last=False` a ragged final batch is padded back to `batch_size`
+    with label -1 rows (masked out by metrics) for the same reason.
+
+    `batch_size` is this host's PER-HOST batch; `cli.common.build_loaders`
+    divides the user-facing global batch by `jax.process_count()` before
+    constructing Loaders.
     """
 
     dataset: ArrayDataset
@@ -121,4 +127,18 @@ class Loader:
                 images = normalize(images, self.mean, self.std)
             else:
                 images = images.astype(np.float32) / 255.0
+            if len(idx) < self.batch_size:
+                # Ragged final batch (drop_last=False): pad to the static
+                # batch shape so XLA never sees a second shape and the
+                # 'data'-axis sharding stays divisible. Padding rows carry
+                # label -1; metrics/losses mask them out (metrics.py
+                # valid_count).
+                pad_n = self.batch_size - len(idx)
+                images = np.concatenate(
+                    [images, np.zeros((pad_n,) + images.shape[1:],
+                                      images.dtype)]
+                )
+                labels = np.concatenate(
+                    [labels, np.full((pad_n,), -1, labels.dtype)]
+                )
             yield images, labels

@@ -44,6 +44,7 @@ from distributed_model_parallel_tpu.models.layers import Context, Layer
 from distributed_model_parallel_tpu.training.metrics import (
     cross_entropy,
     topk_correct,
+    valid_count,
 )
 from distributed_model_parallel_tpu.training.optim import SGD, SGDState
 
@@ -75,11 +76,15 @@ class TrainState(NamedTuple):
 
 
 def _metrics(loss, logits, labels):
+    # `loss` is the mean over valid rows; padding rows (label -1, from the
+    # Loader's static-shape padding of a ragged final val batch) are
+    # excluded from every numerator and denominator.
+    n = valid_count(labels)
     return {
-        "loss_sum": loss * labels.shape[0],
+        "loss_sum": loss * n,
         "correct1": topk_correct(logits, labels, 1),
         "correct5": topk_correct(logits, labels, 5),
-        "count": jnp.asarray(labels.shape[0], jnp.float32),
+        "count": n,
     }
 
 

@@ -67,6 +67,7 @@ from distributed_model_parallel_tpu.parallel.data_parallel import (
 from distributed_model_parallel_tpu.training.metrics import (
     cross_entropy,
     topk_correct,
+    valid_count,
 )
 from distributed_model_parallel_tpu.training.optim import SGD
 
@@ -290,7 +291,9 @@ class PipelineEngine:
             # cotangents upstream, and callers psum the VALUE for
             # reporting after grad.
             is_last = (s_idx == S - 1).astype(logits.dtype)
-            loss_sum = cross_entropy(logits, labels) * n_local * is_last
+            loss_sum = (
+                cross_entropy(logits, labels) * valid_count(labels) * is_last
+            )
             return loss_sum, (logits, new_state, is_last)
 
         def reassemble_state(new_state, s_idx):
@@ -315,7 +318,7 @@ class PipelineEngine:
                 "correct5": lax.psum(
                     topk_correct(logits, labels, 5) * is_last, "stage"
                 ),
-                "count": jnp.asarray(labels.shape[0], jnp.float32),
+                "count": valid_count(labels),
             }
             return {k: lax.psum(v, "data") for k, v in m.items()}
 

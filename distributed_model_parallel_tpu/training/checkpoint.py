@@ -11,9 +11,11 @@ under `--resume` (`data_parallel.py:80-87`). Two reference quirks we fix
   (params, BN stats, momentum buffers, step) plus the epoch and best-acc
   go into the snapshot;
 * the reference stores `DataParallel`-wrapped `module.*` keys (SURVEY.md
-  §3.4) — a functional pytree has no wrapper prefix, so checkpoints are
-  engine-agnostic by construction: a DP-trained snapshot restores into a
-  DDP/pipeline engine unchanged.
+  §3.4) — a functional pytree has no wrapper prefix, so DP and DDP
+  checkpoints are interchangeable (same TrainState structure). Pipeline
+  TrainStates hold per-stage param tuples; moving a DP snapshot into a
+  pipeline engine requires re-partitioning with the model family's
+  `partition_pytree` first (restore matches leaf paths exactly).
 
 Format: one `.npz` holding every leaf keyed by its flattened pytree path,
 plus a JSON sidecar with scalar metadata (acc, epoch, leaf treedef paths).
@@ -103,39 +105,49 @@ def restore_checkpoint(
     )
 
     acc, epoch = 0.0, 0
+    error: Optional[Exception] = None
+    new_leaves = None
     if jax.process_index() == 0 or os.path.isfile(npz_path):
-        # Host 0 (or any host sharing the filesystem) reads the file.
-        if not os.path.isfile(npz_path):
-            raise FileNotFoundError(
-                f"Error: no checkpoint found at {npz_path}"
-            )
-        with np.load(npz_path) as data:
-            arrays = {k: data[k] for k in data.files}
-        new_leaves = []
-        for path, leaf in leaves_with_paths:
-            key = _path_str(path)
-            if key not in arrays:
-                raise KeyError(
-                    f"checkpoint at {npz_path} is missing leaf '{key}' — "
-                    f"model structure changed since save"
+        # Host 0 (or any host sharing the filesystem) reads the file. A
+        # failure here must NOT raise before the broadcast below, or the
+        # hosts on the zeros-placeholder path would block forever in
+        # broadcast_one_to_all; capture it and re-raise on all hosts
+        # together after agreeing on the outcome.
+        try:
+            if not os.path.isfile(npz_path):
+                raise FileNotFoundError(
+                    f"Error: no checkpoint found at {npz_path}"
                 )
-            arr = arrays[key]
-            want = tuple(getattr(leaf, "shape", np.shape(leaf)))
-            if tuple(arr.shape) != want:
-                raise ValueError(
-                    f"checkpoint leaf '{key}' has shape {arr.shape}, "
-                    f"expected {want}"
-                )
-            dtype = getattr(leaf, "dtype", None) or np.asarray(leaf).dtype
-            new_leaves.append(arr.astype(dtype))
-        if os.path.isfile(meta_path):
-            with open(meta_path) as f:
-                meta = json.load(f)
-            acc = float(meta.get("acc", 0.0))
-            epoch = int(meta.get("epoch", 0))
-    else:
-        # Host without the file (per-host local disks): receive host-0's
-        # copy via the broadcast below; zeros are placeholders.
+            with np.load(npz_path) as data:
+                arrays = {k: data[k] for k in data.files}
+            new_leaves = []
+            for path, leaf in leaves_with_paths:
+                key = _path_str(path)
+                if key not in arrays:
+                    raise KeyError(
+                        f"checkpoint at {npz_path} is missing leaf '{key}' "
+                        f"— model structure changed since save"
+                    )
+                arr = arrays[key]
+                want = tuple(getattr(leaf, "shape", np.shape(leaf)))
+                if tuple(arr.shape) != want:
+                    raise ValueError(
+                        f"checkpoint leaf '{key}' has shape {arr.shape}, "
+                        f"expected {want}"
+                    )
+                dtype = getattr(leaf, "dtype", None) or np.asarray(leaf).dtype
+                new_leaves.append(arr.astype(dtype))
+            if os.path.isfile(meta_path):
+                with open(meta_path) as f:
+                    meta = json.load(f)
+                acc = float(meta.get("acc", 0.0))
+                epoch = int(meta.get("epoch", 0))
+        except Exception as e:  # noqa: BLE001 — re-raised after broadcast
+            error = e
+            new_leaves = None  # may be partially filled; use placeholders
+    if new_leaves is None:
+        # Host without the file (per-host local disks) or a failed read:
+        # placeholders, replaced by host-0's broadcast below.
         new_leaves = [
             np.zeros(
                 tuple(getattr(leaf, "shape", np.shape(leaf))),
@@ -147,13 +159,24 @@ def restore_checkpoint(
 
     if jax.process_count() > 1:
         # Hosts may have per-host disks (host 0 wrote the snapshot alone);
-        # broadcast host-0's restore so every process resumes identically.
+        # agree on success first so a host-0 failure surfaces everywhere
+        # instead of deadlocking the placeholder hosts, then broadcast
+        # host-0's restore so every process resumes identically.
         from jax.experimental import multihost_utils
 
+        ok = multihost_utils.broadcast_one_to_all(
+            np.int32(0 if error is not None else 1)
+        )
+        if not int(ok):
+            raise error if error is not None else RuntimeError(
+                "checkpoint restore failed on host 0"
+            )
         state, acc_ep = multihost_utils.broadcast_one_to_all(
             (state, (np.float32(acc), np.int32(epoch)))
         )
         acc, epoch = float(acc_ep[0]), int(acc_ep[1])
+    elif error is not None:
+        raise error
     return state, acc, epoch
 
 

@@ -15,21 +15,35 @@ import jax
 import jax.numpy as jnp
 
 
+def valid_count(labels: jax.Array) -> jax.Array:
+    """Number of real (non-padding) samples in the batch. Padding rows are
+    marked with label -1 by the Loader when it pads a ragged final val
+    batch to a static shape; full training batches have no padding, so
+    this equals the batch size there."""
+    return jnp.sum((labels >= 0).astype(jnp.float32))
+
+
 def cross_entropy(logits: jax.Array, labels: jax.Array) -> jax.Array:
-    """Mean softmax cross-entropy over the batch, computed in f32."""
+    """Mean softmax cross-entropy over the *valid* rows of the batch,
+    computed in f32. Padding rows (label -1, see `valid_count`) contribute
+    zero loss and zero count."""
     logits = logits.astype(jnp.float32)
+    valid = (labels >= 0).astype(jnp.float32)
+    safe = jnp.maximum(labels, 0)
     logz = jax.nn.logsumexp(logits, axis=-1)
-    true_logit = jnp.take_along_axis(logits, labels[:, None], axis=-1)[:, 0]
-    return jnp.mean(logz - true_logit)
+    true_logit = jnp.take_along_axis(logits, safe[:, None], axis=-1)[:, 0]
+    per_example = (logz - true_logit) * valid
+    return jnp.sum(per_example) / jnp.maximum(jnp.sum(valid), 1.0)
 
 
 def topk_correct(logits: jax.Array, labels: jax.Array, k: int) -> jax.Array:
-    """Count of samples whose label is in the top-k logits (sum, not %,
-    so counts psum correctly across shards). `k` is clamped to the number
-    of classes so acc5 is well-defined on few-class heads."""
+    """Count of valid samples whose label is in the top-k logits (sum, not
+    %, so counts psum correctly across shards). `k` is clamped to the
+    number of classes so acc5 is well-defined on few-class heads; padding
+    rows (label -1) never count."""
     _, pred = jax.lax.top_k(logits, min(k, logits.shape[-1]))
     hit = jnp.any(pred == labels[:, None], axis=-1)
-    return jnp.sum(hit.astype(jnp.float32))
+    return jnp.sum(hit.astype(jnp.float32) * (labels >= 0).astype(jnp.float32))
 
 
 def accuracy(logits: jax.Array, labels: jax.Array, topk=(1,)) -> list[jax.Array]:

@@ -112,6 +112,17 @@ class Trainer:
                 f"==> Resumed from checkpoint: epoch {last_epoch}, "
                 f"best acc {self.best_acc:.3f}"
             )
+            if self.start_epoch >= config.epochs:
+                # Deliberate deviation from the reference, which always
+                # trains `epochs` FURTHER epochs on resume
+                # (`data_parallel.py:160`); here fit() runs
+                # range(start_epoch, epochs), so resuming a finished run
+                # is a no-op — say so instead of silently returning.
+                self._log_print(
+                    f"==> WARNING: checkpoint is at epoch {last_epoch} but "
+                    f"--epochs is {config.epochs}; fit() will train 0 "
+                    f"epochs. Raise --epochs to continue training."
+                )
         self.history: list[dict] = []
 
     # ------------------------------------------------------------- loops

@@ -12,7 +12,7 @@ import numpy as np
 import pytest
 
 from distributed_model_parallel_tpu.runtime.mesh import MeshSpec, make_mesh
-from distributed_model_parallel_tpu.models import mobilenet_v2
+from distributed_model_parallel_tpu.models import mobilenet_v2, tiny_cnn
 from distributed_model_parallel_tpu.parallel import (
     DataParallelEngine,
     DDPEngine,
@@ -20,6 +20,11 @@ from distributed_model_parallel_tpu.parallel import (
 from distributed_model_parallel_tpu.training.optim import SGD
 
 BATCH = 16
+
+# Every full-MobileNetV2 test below is marked `slow` (minutes of CPU
+# compile time each) and has a tiny_cnn twin running the same engines and
+# assertions in seconds; tiny_cnn has BatchNorm, so the SyncBN/local-BN
+# paths are equally covered.
 
 
 def _batch(key):
@@ -85,12 +90,7 @@ def test_sharded_grads_match_single_device_exactly(meshes, rng):
     _tree_close(grads["dp8"], grads["dp1"], atol=1e-6)
 
 
-def test_gspmd_matches_single_device(meshes, rng):
-    """8-way sharded full-MobileNetV2 step ≈ single-device step. Tolerance
-    is loose (1e-3) because reduction-order noise (~1e-7, see the exact
-    test above) is amplified through 54 BatchNorm rsqrt nonlinearities in
-    the backward pass; the math is identical."""
-    model = mobilenet_v2(10)
+def _gspmd_parity(model, meshes, rng, atol, rtol):
     opt = SGD()
     results = {}
     for name, mesh in meshes.items():
@@ -99,7 +99,7 @@ def test_gspmd_matches_single_device(meshes, rng):
         images, labels = eng.shard_batch(*_batch(jax.random.PRNGKey(7)))
         ts2, m = eng.train_step(ts, images, labels, 0.1)
         results[name] = (ts2.params, m)
-    _tree_close(results["dp8"][0], results["dp1"][0], atol=2e-3, rtol=5e-2)
+    _tree_close(results["dp8"][0], results["dp1"][0], atol=atol, rtol=rtol)
     np.testing.assert_allclose(
         float(results["dp8"][1]["loss_sum"]),
         float(results["dp1"][1]["loss_sum"]),
@@ -107,11 +107,22 @@ def test_gspmd_matches_single_device(meshes, rng):
     )
 
 
-def test_ddp_syncbn_matches_gspmd(meshes, rng):
-    """shard_map + explicit pmean (sync_bn=True) == GSPMD jit engine:
-    the explicit DDP collective structure computes the same math XLA's
-    partitioner derives automatically."""
-    model = mobilenet_v2(10)
+def test_gspmd_matches_single_device_tiny(meshes, rng):
+    """8-way sharded tiny_cnn step ≈ single-device step (BN model, so the
+    global-batch-stats path is exercised)."""
+    _gspmd_parity(tiny_cnn(10), meshes, rng, atol=1e-5, rtol=1e-4)
+
+
+@pytest.mark.slow
+def test_gspmd_matches_single_device(meshes, rng):
+    """Full-MobileNetV2 twin of the tiny parity test. Tolerance is loose
+    (2e-3) because reduction-order noise (~1e-7, see the exact test above)
+    is amplified through 54 BatchNorm rsqrt nonlinearities in the backward
+    pass; the math is identical."""
+    _gspmd_parity(mobilenet_v2(10), meshes, rng, atol=2e-3, rtol=5e-2)
+
+
+def _syncbn_parity(model, meshes, rng, atol, rtol):
     opt = SGD()
     mesh = meshes["dp8"]
     images, labels = _batch(jax.random.PRNGKey(7))
@@ -124,18 +135,27 @@ def test_ddp_syncbn_matches_gspmd(meshes, rng):
     ts1 = ddp.init_state(rng)
     ts_d, m_d = ddp.train_step(ts1, *ddp.shard_batch(images, labels), 0.1)
 
-    _tree_close(ts_g.params, ts_d.params, atol=1e-3, rtol=5e-2)
-    _tree_close(ts_g.model_state, ts_d.model_state, atol=1e-3, rtol=5e-2)
+    _tree_close(ts_g.params, ts_d.params, atol=atol, rtol=rtol)
+    _tree_close(ts_g.model_state, ts_d.model_state, atol=atol, rtol=rtol)
     np.testing.assert_allclose(
         float(m_g["correct1"]), float(m_d["correct1"]), atol=0.5
     )
 
 
-def test_ddp_local_bn_differs_but_converges_shape(meshes, rng):
-    """sync_bn=False is nn.DataParallel's per-replica-BN semantics: grads
-    legitimately differ from global-BN, but the step must still run and
-    produce replicated finite params."""
-    model = mobilenet_v2(10)
+def test_ddp_syncbn_matches_gspmd_tiny(meshes, rng):
+    """shard_map + explicit pmean (sync_bn=True) == GSPMD jit engine:
+    the explicit DDP collective structure computes the same math XLA's
+    partitioner derives automatically."""
+    _syncbn_parity(tiny_cnn(10), meshes, rng, atol=1e-5, rtol=1e-4)
+
+
+@pytest.mark.slow
+def test_ddp_syncbn_matches_gspmd(meshes, rng):
+    """Full-MobileNetV2 twin of the tiny SyncBN-parity test."""
+    _syncbn_parity(mobilenet_v2(10), meshes, rng, atol=1e-3, rtol=5e-2)
+
+
+def _local_bn_step(model, meshes, rng):
     ddp = DDPEngine(model, SGD(), meshes["dp8"], sync_bn=False, donate=False)
     ts = ddp.init_state(rng)
     images, labels = ddp.shard_batch(*_batch(jax.random.PRNGKey(7)))
@@ -145,10 +165,19 @@ def test_ddp_local_bn_differs_but_converges_shape(meshes, rng):
     assert float(m["count"]) == BATCH
 
 
-def test_multi_step_loss_decreases(meshes, rng):
-    """Convergence smoke mirroring the reference's empirical acceptance
-    test: a few steps on a fixed batch must reduce loss."""
-    model = mobilenet_v2(10)
+def test_ddp_local_bn_differs_but_converges_shape_tiny(meshes, rng):
+    """sync_bn=False is nn.DataParallel's per-replica-BN semantics: grads
+    legitimately differ from global-BN, but the step must still run and
+    produce replicated finite params."""
+    _local_bn_step(tiny_cnn(10), meshes, rng)
+
+
+@pytest.mark.slow
+def test_ddp_local_bn_differs_but_converges_shape(meshes, rng):
+    _local_bn_step(mobilenet_v2(10), meshes, rng)
+
+
+def _loss_decreases(model, meshes, rng):
     eng = DataParallelEngine(model, SGD(), meshes["dp8"], donate=False)
     ts = eng.init_state(rng)
     images, labels = eng.shard_batch(*_batch(jax.random.PRNGKey(7)))
@@ -157,3 +186,14 @@ def test_multi_step_loss_decreases(meshes, rng):
         ts, m = eng.train_step(ts, images, labels, 0.05)
         losses.append(float(m["loss_sum"]) / float(m["count"]))
     assert losses[-1] < losses[0]
+
+
+def test_multi_step_loss_decreases_tiny(meshes, rng):
+    """Convergence smoke mirroring the reference's empirical acceptance
+    test: a few steps on a fixed batch must reduce loss."""
+    _loss_decreases(tiny_cnn(10), meshes, rng)
+
+
+@pytest.mark.slow
+def test_multi_step_loss_decreases(meshes, rng):
+    _loss_decreases(mobilenet_v2(10), meshes, rng)
