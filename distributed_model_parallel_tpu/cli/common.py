@@ -140,10 +140,27 @@ def check_batch_divisibility(
         )
 
 
+def compute_dtype_from_flag(name: str):
+    """--dtype flag value -> engine compute_dtype (None = pure f32)."""
+    import jax.numpy as jnp
+
+    return {"float32": None, "bfloat16": jnp.bfloat16}[name]
+
+
 def add_common_tpu_flags(parser: argparse.ArgumentParser) -> None:
     parser.add_argument(
         "--model", default="mobilenetv2", choices=sorted(MODELS),
         help="model family (reference hard-codes MobileNetV2)",
+    )
+    parser.add_argument(
+        "--dtype", default="float32", choices=("float32", "bfloat16"),
+        help="activation/compute dtype (params stay f32); bfloat16 is the "
+             "TPU MXU's native matmul precision",
+    )
+    parser.add_argument(
+        "--profile-dir", default=None,
+        help="capture a jax.profiler trace of a few steady-state steps "
+             "into this directory",
     )
     parser.add_argument(
         "--steps-per-epoch", default=0, type=int,

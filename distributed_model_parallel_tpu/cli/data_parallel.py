@@ -29,6 +29,7 @@ from distributed_model_parallel_tpu.cli.common import (
     build_loaders,
     build_model,
     check_batch_divisibility,
+    compute_dtype_from_flag,
 )
 from distributed_model_parallel_tpu.parallel.data_parallel import (
     DataParallelEngine,
@@ -83,10 +84,13 @@ def main(argv=None) -> dict:
     )
     model = build_model(args.model, num_classes)
     opt = SGD(momentum=args.momentum, weight_decay=args.weight_decay)
+    cdt = compute_dtype_from_flag(args.dtype)
     if args.engine == "ddp":
-        engine = DDPEngine(model, opt, mesh, sync_bn=args.sync_bn)
+        engine = DDPEngine(
+            model, opt, mesh, sync_bn=args.sync_bn, compute_dtype=cdt
+        )
     else:
-        engine = DataParallelEngine(model, opt, mesh)
+        engine = DataParallelEngine(model, opt, mesh, compute_dtype=cdt)
     cfg = TrainerConfig(
         epochs=args.epochs,
         base_lr=args.lr,
@@ -95,6 +99,7 @@ def main(argv=None) -> dict:
         log_file=args.log_file or f"data_para_{args.batch_size}.txt",
         resume=args.resume,
         steps_per_epoch=args.steps_per_epoch,
+        profile_dir=args.profile_dir,
     )
     trainer = Trainer(engine, train, val, cfg, rng=jax.random.PRNGKey(0))
     return trainer.fit()

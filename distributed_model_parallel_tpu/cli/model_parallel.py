@@ -31,6 +31,7 @@ from distributed_model_parallel_tpu.cli.common import (
     add_common_tpu_flags,
     build_loaders,
     check_batch_divisibility,
+    compute_dtype_from_flag,
 )
 from distributed_model_parallel_tpu.parallel.pipeline import PipelineEngine
 from distributed_model_parallel_tpu.runtime.dist import initialize_backend
@@ -112,6 +113,7 @@ def main(argv=None) -> dict:
         SGD(momentum=args.momentum, weight_decay=args.weight_decay),
         mesh,
         num_microbatches=args.microbatches,
+        compute_dtype=compute_dtype_from_flag(args.dtype),
     )
     cfg = TrainerConfig(
         epochs=args.epochs,
@@ -120,6 +122,7 @@ def main(argv=None) -> dict:
         warmup_period=10,
         log_file=args.log_file or f"{args.batch_size}.txt",
         steps_per_epoch=args.steps_per_epoch,
+        profile_dir=args.profile_dir,
     )
     trainer = Trainer(engine, train, val, cfg, rng=jax.random.PRNGKey(0))
     return trainer.fit()

@@ -49,6 +49,12 @@ class Context:
     bn_axis: Optional[str] = None
     # PRNG key for stochastic layers (dropout); None in eval.
     rng: Optional[jax.Array] = None
+    # Activation compute dtype (mixed precision). None => follow the input
+    # dtype unchanged. When set (e.g. jnp.bfloat16), "source" layers whose
+    # output dtype comes from params rather than activations (embedding)
+    # cast their output to it; everything downstream follows x.dtype, and
+    # params stay f32 master copies (cast per-use inside each layer).
+    dtype: Optional[Any] = None
 
     def child(self, i: int) -> "Context":
         """Context for the i-th child of a combinator: folds the child
@@ -215,7 +221,10 @@ def embedding(vocab: int, dim: int, *, scale: float = 0.02) -> Layer:
         return {"table": scale * jax.random.normal(key, (vocab, dim))}, {}
 
     def apply(params, state, ids, ctx):
-        return jnp.take(params["table"], ids, axis=0), state
+        out = jnp.take(params["table"], ids, axis=0)
+        if ctx.dtype is not None:
+            out = out.astype(ctx.dtype)
+        return out, state
 
     return Layer(init, apply)
 

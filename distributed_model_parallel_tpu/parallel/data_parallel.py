@@ -75,6 +75,16 @@ class TrainState(NamedTuple):
     step: jax.Array
 
 
+def _cast_input(x, dtype):
+    """Cast a floating input batch to the engine's compute dtype (mixed
+    precision). Integer inputs (token ids) pass through — for those the
+    cast happens at the first floating-point source layer via
+    `Context.dtype` (see `models/layers.py` embedding)."""
+    if dtype is None or not jnp.issubdtype(x.dtype, jnp.floating):
+        return x
+    return x.astype(dtype)
+
+
 def _metrics(loss, logits, labels):
     # `loss` is the mean over valid rows; padding rows (label -1, from the
     # Loader's static-shape padding of a ragged final val batch) are
@@ -97,20 +107,27 @@ class DataParallelEngine:
     optimizer: SGD
     mesh: Mesh
     donate: bool = True
+    # Mixed precision: activations/compute in this dtype (e.g. jnp.bfloat16
+    # — the TPU MXU's native matmul dtype), params/optimizer/loss in f32.
+    # None keeps the input dtype (f32 path).
+    compute_dtype: Any = None
 
     def __post_init__(self):
         mesh = self.mesh
         self._repl = NamedSharding(mesh, P())
         self._batch = NamedSharding(mesh, P(("data",)))
+        cdt = self.compute_dtype
 
         def train_step(ts: TrainState, images, labels, lr):
             # Deterministic per-step dropout key (global batch => one key;
             # the partitioner shards the mask with the activations).
             rng = jax.random.fold_in(jax.random.PRNGKey(0), ts.step)
+            images_c = _cast_input(images, cdt)
 
             def loss_fn(params, model_state):
                 logits, new_state = self.model.apply(
-                    params, model_state, images, Context(train=True, rng=rng)
+                    params, model_state, images_c,
+                    Context(train=True, rng=rng, dtype=cdt),
                 )
                 loss = cross_entropy(logits, labels)
                 return loss, (new_state, logits)
@@ -126,7 +143,8 @@ class DataParallelEngine:
 
         def eval_step(ts: TrainState, images, labels):
             logits, _ = self.model.apply(
-                ts.params, ts.model_state, images, Context(train=False)
+                ts.params, ts.model_state, _cast_input(images, cdt),
+                Context(train=False, dtype=cdt),
             )
             loss = cross_entropy(logits, labels)
             return _metrics(loss, logits, labels)
@@ -179,12 +197,14 @@ class DDPEngine:
     mesh: Mesh
     sync_bn: bool = False
     donate: bool = True
+    compute_dtype: Any = None  # see DataParallelEngine
 
     def __post_init__(self):
         mesh = self.mesh
         self._repl = NamedSharding(mesh, P())
         self._batch = NamedSharding(mesh, P(("data",)))
         bn_axis = "data" if self.sync_bn else None
+        cdt = self.compute_dtype
 
         @partial(
             shard_map,
@@ -202,10 +222,12 @@ class DDPEngine:
                 lax.axis_index("data"),
             )
 
+            images_c = _cast_input(images, cdt)
+
             def loss_fn(params, model_state):
                 logits, new_state = self.model.apply(
-                    params, model_state, images,
-                    Context(train=True, bn_axis=bn_axis, rng=rng),
+                    params, model_state, images_c,
+                    Context(train=True, bn_axis=bn_axis, rng=rng, dtype=cdt),
                 )
                 loss = cross_entropy(logits, labels)
                 return loss, (new_state, logits)
@@ -236,7 +258,8 @@ class DDPEngine:
         )
         def shard_eval(ts: TrainState, images, labels):
             logits, _ = self.model.apply(
-                ts.params, ts.model_state, images, Context(train=False)
+                ts.params, ts.model_state, _cast_input(images, cdt),
+                Context(train=False, dtype=cdt),
             )
             loss = cross_entropy(logits, labels)
             m = _metrics(loss, logits, labels)

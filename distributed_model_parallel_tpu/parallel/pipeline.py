@@ -37,11 +37,12 @@ Design notes / v1 tradeoffs:
   only its own stage (switch branch) but *stores* all stages. For the
   reference-scale models (MobileNetV2 ~2.3M params) this is noise; sharding
   param storage per stage is future work.
-* Activations cross stages in one f32 buffer padded to the largest
-  inter-stage tensor, so every ppermute has one static shape. Stage I/O
-  shapes come from a setup-time `jax.eval_shape` chain over the stages —
-  the static replacement for the reference's per-transfer dim/size
-  messages.
+* Activations cross stages in one flat buffer padded to the largest
+  inter-stage tensor, so every ppermute has one static shape. The buffer
+  dtype is the common type of all stage-I/O leaves (bf16 under mixed
+  precision — half the ICI bytes of f32). Stage I/O shapes come from a
+  setup-time `jax.eval_shape` chain over the stages — the static
+  replacement for the reference's per-transfer dim/size messages.
 * Invalid ticks (pipeline bubble) still execute the branch on a zeros
   buffer (SPMD lockstep); their outputs and BN-state updates are masked.
 """
@@ -51,7 +52,7 @@ from __future__ import annotations
 import dataclasses
 import math
 from functools import partial
-from typing import List, Sequence, Tuple
+from typing import Any, List, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -62,6 +63,7 @@ from jax import shard_map
 from distributed_model_parallel_tpu.models.layers import Context, Layer
 from distributed_model_parallel_tpu.parallel.data_parallel import (
     TrainState,
+    _cast_input,
     _place_batch,
 )
 from distributed_model_parallel_tpu.training.metrics import (
@@ -80,15 +82,29 @@ def _tree_size(aval_tree) -> int:
     )
 
 
-def _pack(tree, buf_size: int) -> jax.Array:
-    """Pytree of arrays -> one flat f32 buffer padded to `buf_size` (the
-    wire format between stages; one static ppermute shape for everything)."""
+def _wire_dtype(avals) -> jnp.dtype:
+    """Dtype of the inter-stage wire buffer: the common type of every
+    stage-I/O leaf. bf16 activations give a bf16 wire (half the ppermute
+    bytes of f32); bool masks riding alongside (BERT's (hidden, mask) pair)
+    promote into it losslessly (0/1 exact in every float dtype)."""
+    dtypes = {
+        leaf.dtype
+        for in_aval, out_aval in avals
+        for leaf in jax.tree_util.tree_leaves((in_aval, out_aval))
+    }
+    return jnp.result_type(*dtypes) if dtypes else jnp.dtype(jnp.float32)
+
+
+def _pack(tree, buf_size: int, dtype=jnp.float32) -> jax.Array:
+    """Pytree of arrays -> one flat buffer of `dtype` padded to `buf_size`
+    (the wire format between stages; one static ppermute shape for
+    everything)."""
     flats = [
-        leaf.astype(jnp.float32).reshape(-1)
+        leaf.astype(dtype).reshape(-1)
         for leaf in jax.tree_util.tree_leaves(tree)
     ]
     flat = jnp.concatenate(flats) if len(flats) > 1 else flats[0]
-    return jnp.zeros((buf_size,), jnp.float32).at[: flat.shape[0]].set(flat)
+    return jnp.zeros((buf_size,), dtype).at[: flat.shape[0]].set(flat)
 
 
 def _unpack(buf: jax.Array, aval_tree):
@@ -120,6 +136,7 @@ class PipelineEngine:
     num_microbatches: int = 1
     sync_bn: bool = False
     donate: bool = True
+    compute_dtype: Any = None  # mixed precision; see DataParallelEngine
 
     def __post_init__(self):
         mesh = self.mesh
@@ -161,8 +178,9 @@ class PipelineEngine:
         the static replacement for the reference's runtime dim/size
         handshake (`distributed_layers.py:40-47`). Stage I/O may be any
         pytree of arrays (e.g. BERT's (hidden, mask) pair); everything
-        crosses stages packed into one flat f32 buffer."""
-        ctx = Context(train=train)
+        crosses stages packed into one flat buffer of the common wire
+        dtype."""
+        ctx = Context(train=train, dtype=self.compute_dtype)
         aval = x_aval
         avals = []
         for i, stage in enumerate(self.stages):
@@ -181,11 +199,13 @@ class PipelineEngine:
         M = self.num_microbatches
         mesh = self.mesh
         bn_axis = "data" if self.sync_bn else None
+        cdt = self.compute_dtype
 
         def pipeline_forward(params, model_state, images, labels, step):
             """Runs on ONE device (inside shard_map): the full fill-drain
             schedule for this device's stage. Returns (sum CE over local
             batch, logits for the local batch, updated state)."""
+            images = _cast_input(images, cdt)
             n_local = images.shape[0]
             if n_local % M:
                 raise ValueError(
@@ -205,6 +225,7 @@ class PipelineEngine:
                 )
             num_classes = out_leaves[0].shape[-1]
             buf_size = max(_tree_size(out) for _, out in avals)
+            wire_dt = _wire_dtype(avals)
             s_idx = lax.axis_index("stage")
 
             def make_branch(i):
@@ -212,7 +233,9 @@ class PipelineEngine:
 
                 def branch(operand):
                     state, buf, images_mb, rng = operand
-                    ctx = Context(train=train, bn_axis=bn_axis, rng=rng)
+                    ctx = Context(
+                        train=train, bn_axis=bn_axis, rng=rng, dtype=cdt
+                    )
                     if i == 0:
                         x = images_mb
                     else:
@@ -220,7 +243,7 @@ class PipelineEngine:
                     y, new_si = self.stages[i].apply(
                         params[i], state[i], x, ctx
                     )
-                    y_pad = _pack(y, buf_size)
+                    y_pad = _pack(y, buf_size, wire_dt)
                     new_state = tuple(
                         new_si if j == i else state[j] for j in range(S)
                     )
@@ -258,7 +281,13 @@ class PipelineEngine:
                     new_state, state,
                 )
                 y_pad = jnp.where(valid, y_pad, jnp.zeros_like(y_pad))
-                logits_mb = y_pad[: mb * num_classes].reshape(mb, num_classes)
+                # Logits stack stays f32 regardless of the wire dtype so
+                # the loss/metrics see the same precision on every path.
+                logits_mb = (
+                    y_pad[: mb * num_classes]
+                    .reshape(mb, num_classes)
+                    .astype(jnp.float32)
+                )
                 out_stack = lax.dynamic_update_index_in_dim(
                     out_stack,
                     jnp.where(
@@ -275,7 +304,7 @@ class PipelineEngine:
                     )
                 return (buf, state, out_stack), None
 
-            buf0 = jnp.zeros((buf_size,), jnp.float32)
+            buf0 = jnp.zeros((buf_size,), wire_dt)
             out0 = jnp.zeros((M, mb, num_classes), jnp.float32)
             (buf, new_state, out_stack), _ = lax.scan(
                 tick,

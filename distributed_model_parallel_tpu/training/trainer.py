@@ -76,6 +76,11 @@ class TrainerConfig:
     # Truncate each training epoch to N batches (0 = full epoch) — for
     # smoke runs and throughput benchmarking.
     steps_per_epoch: int = 0
+    # Capture a jax.profiler trace of a few steady-state train steps
+    # (compile and warmup excluded) into this directory; None disables.
+    # The trace is the tool for attributing a bad MFU number (SURVEY.md §5
+    # tracing row) — open with TensorBoard or xprof.
+    profile_dir: Optional[str] = None
 
 
 class Trainer:
@@ -124,6 +129,7 @@ class Trainer:
                     f"epochs. Raise --epochs to continue training."
                 )
         self.history: list[dict] = []
+        self._profiled = False
 
     # ------------------------------------------------------------- loops
 
@@ -138,6 +144,18 @@ class Trainer:
         sums = None
         n_batches = 0
         data_time = 0.0
+        # Profile steps 10-12 of the first profiled epoch (past compile and
+        # cache warmup); short smoke epochs profile from the first step so
+        # the capture is never silently empty.
+        profile_at = None
+        if cfg.profile_dir and not self._profiled:
+            n_avail = cfg.steps_per_epoch or (
+                len(self.train_loader)
+                if hasattr(self.train_loader, "__len__")
+                else None
+            )
+            profile_at = 10 if (n_avail is None or n_avail > 12) else 0
+        profiling = False
         epoch_start = time.perf_counter()
         while True:
             if cfg.steps_per_epoch and n_batches >= cfg.steps_per_epoch:
@@ -148,10 +166,19 @@ class Trainer:
             except StopIteration:
                 break
             data_time += time.perf_counter() - t0
+            if profile_at is not None and n_batches == profile_at:
+                jax.block_until_ready(self.state)  # trace excludes backlog
+                jax.profiler.start_trace(cfg.profile_dir)
+                profiling = True
             images, labels = self.engine.shard_batch(images, labels)
             self.state, metrics = self.engine.train_step(
                 self.state, images, labels, lr
             )
+            if profiling and n_batches >= profile_at + 2:
+                jax.block_until_ready(self.state)
+                jax.profiler.stop_trace()
+                profiling = False
+                self._profiled = True
             sums = (
                 metrics
                 if sums is None
@@ -167,6 +194,9 @@ class Trainer:
                     f"\tTime {(time.perf_counter() - epoch_start) / n_batches:.3f}"
                 )
         jax.block_until_ready(self.state)
+        if profiling:  # epoch ended inside the capture window
+            jax.profiler.stop_trace()
+            self._profiled = True
         wall = time.perf_counter() - epoch_start
         return self._finalize(sums, n_batches, wall, data_time)
 
