@@ -1,26 +1,30 @@
 """Benchmark entry point — prints ONE JSON line for the driver.
 
 Headline metric: MobileNetV2 CIFAR-10 data-parallel training throughput
-(images/sec across the whole mesh), the exact workload behind the
+(images/sec across the whole mesh) in bf16, the exact workload behind the
 reference's only published performance table: `nn.DataParallel`, batch 512,
 0.396 s/batch on 4 GPUs = 1292.9 images/sec (`Readme.md:283-287`,
 SURVEY.md §6). `vs_baseline` is our images/sec divided by that number.
+The line also carries an MFU estimate (XLA cost-analysis FLOPs / step time
+/ chip peak), the f32 throughput, and explicit model/batch/dtype fields so
+a degraded run can never be mistaken for the real measurement.
 
-Hardened after round 1 (VERDICT.md "What's weak" #3: one backend-init
-failure -> rc=1, no JSON at all):
-* The remote TPU backend is probed in a SUBPROCESS with a timeout and one
-  retry — backend init on this image can block for minutes when the device
-  tunnel is down, and an in-process probe could never be cancelled. A probe
-  that comes back reporting the cpu platform counts as NO accelerator.
-* If no accelerator comes up, the benchmark falls back to the virtual-CPU
-  mesh with a model that compiles in seconds there, and the JSON line says
-  so (`platform: cpu`) instead of crashing.
-* A SIGALRM watchdog bounds total runtime (both modes); on expiry a
-  diagnostic JSON line is emitted and the exit code is still 0.
+Architecture (round-3 redesign per VERDICT r2 item 1 + ADVICE r2):
+* ONE child process dials the default (TPU) backend AND measures — no
+  separate probe that burns the budget twice. The child streams progress
+  to stderr and prints its JSON to stdout.
+* The parent tracks a deadline (`start + TOTAL_BUDGET_S`), gives the child
+  everything except a reserve for the CPU fallback, launches it in its own
+  process group, and kills the whole group on expiry — no orphaned child
+  holding the TPU.
+* On any failure the emitted JSON carries the last ~300 chars of the
+  child's stderr, so a bad round is diagnosable from BENCH_r*.json alone.
+* The CPU fallback (tinycnn, virtual mesh) runs through the same killable
+  child mechanism, labeled `model: tinycnn` + an `error` note.
 
 `--scaling` sweeps the 'data' mesh axis over virtual CPU devices and
 prints an images/sec/chip weak-scaling table (BASELINE.json north-star
-shape) instead of the single line.
+shape) instead of the single line; it also runs inside the killable child.
 """
 
 from __future__ import annotations
@@ -33,61 +37,51 @@ import subprocess
 import sys
 import time
 
-from distributed_model_parallel_tpu.runtime.platform import force_cpu
-
 # Reference: DP 0.396 s/batch @ global batch 512 on 4 GPUs (Readme.md:283-287).
 BASELINE_IMG_PER_SEC = 512 / 0.396
 
 METRIC = "mobilenetv2_cifar10_dp_train_throughput"
 TOTAL_BUDGET_S = int(os.environ.get("BENCH_TIMEOUT_S", "540"))
+CPU_FALLBACK_RESERVE_S = 150  # kept back for the tinycnn fallback child
+
+# Peak bf16 matmul TFLOP/s per chip by TPU generation (public numbers);
+# MFU is measured FLOP/s divided by this. Unknown kinds report mfu: null.
+PEAK_BF16_TFLOPS = {
+    "v4": 275.0,
+    "v5 lite": 197.0,
+    "v5e": 197.0,
+    "v5p": 459.0,
+    "v6 lite": 918.0,
+    "v6e": 918.0,
+}
 
 
-def emit(value: float, unit: str, vs_baseline: float, **extra) -> None:
+def peak_bf16_flops(device_kind: str):
+    kind = device_kind.lower()
+    for key, tflops in sorted(
+        PEAK_BF16_TFLOPS.items(), key=lambda kv: -len(kv[0])
+    ):
+        if key in kind:
+            return tflops * 1e12
+    return None
+
+
+def emit(value: float, vs_baseline: float, **extra) -> None:
     print(json.dumps({
         "metric": METRIC,
         "value": round(value, 1),
-        "unit": unit,
+        "unit": "images/sec",
         "vs_baseline": round(vs_baseline, 3),
         **extra,
     }), flush=True)
 
 
-def accelerator_available(timeout_s: int = 150, attempts: int = 2) -> bool:
-    """True iff `jax.devices()` on the default (tunneled TPU) platform
-    initializes within `timeout_s` AND reports a non-cpu platform. Probed
-    out-of-process so a hung dial can be killed; jax falling back to its
-    CPU backend is counted as no accelerator (running the full-size
-    benchmark on CPU would only hit the watchdog)."""
-    probe = "import jax; print(jax.devices()[0].platform)"
-    for i in range(attempts):
-        try:
-            out = subprocess.run(
-                [sys.executable, "-c", probe],
-                capture_output=True, text=True, timeout=timeout_s,
-            )
-            platform = out.stdout.strip().lower()
-            if out.returncode == 0 and platform and platform != "cpu":
-                return True
-        except subprocess.TimeoutExpired:
-            pass
-        if i + 1 < attempts:
-            time.sleep(5 * (i + 1))
-    return False
+def log(msg: str) -> None:
+    print(f"[bench {time.strftime('%H:%M:%S')}] {msg}",
+          file=sys.stderr, flush=True)
 
 
-def _timed_step_loop(engine, state, images, labels, lr, warmup, iters):
-    """Fenced throughput measurement: returns seconds for `iters` steps
-    after `warmup` compile/warm steps."""
-    import jax
-
-    for _ in range(warmup):
-        state, _ = engine.train_step(state, images, labels, lr)
-    jax.block_until_ready(state)
-    t0 = time.perf_counter()
-    for _ in range(iters):
-        state, _ = engine.train_step(state, images, labels, lr)
-    jax.block_until_ready(state)
-    return time.perf_counter() - t0
+# --------------------------------------------------------------- child side
 
 
 def _fake_batch(batch: int, seed: int = 0):
@@ -99,8 +93,35 @@ def _fake_batch(batch: int, seed: int = 0):
     return images, labels
 
 
-def run_throughput(model_name: str, batch: int, warmup: int, iters: int):
-    """(images/sec, platform) for a DP train step on the current devices."""
+def _aot_step(engine, state, images, labels, lr):
+    """AOT-compile the train step ONCE and return (step_fn, flops).
+
+    Using the same compiled executable for cost analysis and the timing
+    loop avoids the double compile that `lower().compile()` + a jit call
+    would cost (the AOT executable does not populate the jit dispatch
+    cache). Falls back to the jit path with flops=None if the AOT API
+    misbehaves."""
+    try:
+        compiled = engine.train_step.lower(
+            state, images, labels, lr
+        ).compile()
+        cost = compiled.cost_analysis()
+        if isinstance(cost, (list, tuple)):  # older jax returns [dict]
+            cost = cost[0] if cost else {}
+        flops = float(cost.get("flops", 0.0)) or None
+        return (lambda s: compiled(s, images, labels, lr)[0]), flops
+    except Exception as e:  # noqa: BLE001 — flops are best-effort
+        log(f"AOT path unavailable ({type(e).__name__}: {e}); "
+            "falling back to jit dispatch")
+        return (
+            lambda s: engine.train_step(s, images, labels, lr)[0]
+        ), None
+
+
+def _measure(model_name: str, batch: int, dtype_name: str,
+             warmup: int, iters: int):
+    """One throughput measurement on the already-initialized backend.
+    Returns dict with img/sec and (for the bf16 run) flops/step."""
     import jax
     import jax.numpy as jnp
 
@@ -113,70 +134,115 @@ def run_throughput(model_name: str, batch: int, warmup: int, iters: int):
     from distributed_model_parallel_tpu.training.optim import SGD
 
     model = {"mobilenetv2": mobilenet_v2, "tinycnn": tiny_cnn}[model_name](10)
+    cdt = {"bfloat16": jnp.bfloat16, "float32": None}[dtype_name]
     mesh = make_mesh(MeshSpec(data=-1))
-    engine = DataParallelEngine(model=model, optimizer=SGD(), mesh=mesh)
+    engine = DataParallelEngine(
+        model=model, optimizer=SGD(), mesh=mesh, compute_dtype=cdt,
+    )
     state = engine.init_state(jax.random.PRNGKey(0))
     images, labels = engine.shard_batch(*_fake_batch(batch))
-    dt = _timed_step_loop(
-        engine, state, images, labels, jnp.float32(0.2), warmup, iters
-    )
-    return batch * iters / dt, jax.devices()[0].platform
+    lr = jnp.float32(0.2)
+
+    log(f"compiling {model_name} batch={batch} dtype={dtype_name} ...")
+    t0 = time.perf_counter()
+    step, flops = _aot_step(engine, state, images, labels, lr)
+    for _ in range(warmup):
+        state = step(state)
+    jax.block_until_ready(state)
+    log(f"compile+warmup took {time.perf_counter() - t0:.1f}s; measuring")
+    # Adaptive iteration count: size the measurement window to ~3s so a
+    # ~2ms TPU step gets a stable average, not a 60ms-window noise sample.
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        state = step(state)
+    jax.block_until_ready(state)
+    dt = time.perf_counter() - t0
+    if dt < 1.0:
+        sec0 = dt / iters
+        iters = min(int(iters * 3.0 / dt), 3000)
+        log(f"fast step ({sec0:.5f}s); re-measuring with {iters} iters")
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            state = step(state)
+        jax.block_until_ready(state)
+        dt = time.perf_counter() - t0
+    return {
+        "img_per_sec": batch * iters / dt,
+        "sec_per_step": dt / iters,
+        "flops_per_step": flops,
+    }
 
 
-def run_child() -> None:
-    """The real accelerator measurement, run as a killable subprocess of
-    main(): a SIGALRM handler cannot interrupt a thread blocked inside a
-    native PJRT compile/execute call, so an in-process watchdog could not
-    actually bound a hung-tunnel run — a subprocess timeout can."""
-    img_per_sec, platform = run_throughput(
-        "mobilenetv2", batch=512, warmup=5, iters=30
-    )
-    emit(
-        img_per_sec, "images/sec",
-        img_per_sec / BASELINE_IMG_PER_SEC, platform=platform,
-    )
+def run_child(model_name: str, batch: int, dtypes: list[str],
+              cpu: bool = False) -> None:
+    """Dial the backend and measure. The parent bounds our lifetime; we
+    just stream progress and print one JSON line. `cpu` forces the
+    virtual-CPU mesh via jax.config (this image's sitecustomize imports
+    jax at interpreter start, so the JAX_PLATFORMS env var alone is
+    ignored — see runtime/platform.py)."""
+    t0 = time.perf_counter()
+    if cpu:
+        from distributed_model_parallel_tpu.runtime.platform import force_cpu
+
+        force_cpu(8)
+    log("initializing backend...")
+    import jax
+
+    devs = jax.devices()
+    platform = devs[0].platform
+    device_kind = devs[0].device_kind
+    n_chips = len(devs)
+    log(f"backend up in {time.perf_counter() - t0:.1f}s: "
+        f"{n_chips}x {device_kind} ({platform})")
+
+    if not cpu and platform == "cpu":
+        # The backend fell back to CPU (tunnel down but jax imported
+        # cleanly). Bail out NOW: compiling full MobileNetV2 on a 1-core
+        # CPU host takes ~10 min and would burn the whole budget; the
+        # parent sees platform=="cpu" and runs the proper CPU fallback.
+        emit(0.0, 0.0, platform="cpu", model=model_name, batch=batch,
+             error="backend fell back to cpu platform; skipping "
+                   "accelerator-size measurement")
+        return
+
+    results = {}
+    for dtype_name in dtypes:
+        results[dtype_name] = _measure(
+            model_name, batch, dtype_name, warmup=5, iters=30
+        )
+        log(f"{dtype_name}: {results[dtype_name]['img_per_sec']:.1f} img/s")
+
+    head_dtype = dtypes[0]
+    head = results[head_dtype]
+    mfu = None
+    peak = peak_bf16_flops(device_kind)
+    if head["flops_per_step"] and peak:
+        mfu = head["flops_per_step"] / head["sec_per_step"] / (n_chips * peak)
+    extra = {
+        "platform": platform,
+        "device_kind": device_kind,
+        "n_chips": n_chips,
+        "model": model_name,
+        "batch": batch,
+        "dtype": head_dtype,
+        "sec_per_step": round(head["sec_per_step"], 4),
+        "mfu": round(mfu, 4) if mfu is not None else None,
+        "flops_per_step": head["flops_per_step"],
+    }
+    for other in dtypes[1:]:
+        extra[f"{other}_img_per_sec"] = round(
+            results[other]["img_per_sec"], 1
+        )
+    emit(head["img_per_sec"], head["img_per_sec"] / BASELINE_IMG_PER_SEC,
+         **extra)
 
 
-def main() -> None:
-    try:
-        if accelerator_available():
-            out = subprocess.run(
-                [sys.executable, os.path.abspath(__file__), "--child"],
-                capture_output=True, text=True,
-                timeout=max(TOTAL_BUDGET_S - 200, 120),
-            )
-            lines = [
-                l for l in out.stdout.splitlines() if l.startswith("{")
-            ]
-            if out.returncode == 0 and lines:
-                print(lines[-1], flush=True)
-            else:
-                emit(
-                    0.0, "images/sec", 0.0,
-                    error="accelerator run failed: "
-                          + (out.stderr or out.stdout)[-300:],
-                )
-        else:
-            # No accelerator: degrade, don't crash. The tiny model exists
-            # because full MobileNetV2 takes ~10 min to COMPILE on a
-            # 1-core CPU host; a diagnostic number from the same
-            # engine/collective path is better than rc=1.
-            force_cpu()
-            img_per_sec, platform = run_throughput(
-                "tinycnn", batch=256, warmup=2, iters=10
-            )
-            emit(
-                img_per_sec, "images/sec", 0.0, platform=platform,
-                error="accelerator unavailable; tinycnn on virtual-CPU mesh",
-            )
-    except Exception as e:  # noqa: BLE001 — the contract is one JSON line, rc 0
-        emit(0.0, "images/sec", 0.0, error=f"{type(e).__name__}: {e}")
-
-
-def scaling_table(max_devices: int = 8) -> None:
+def run_child_scaling(max_devices: int) -> None:
     """Weak-scaling sweep over the 'data' axis on virtual CPU devices:
     images/sec/chip and efficiency vs N=1 (BASELINE.json north-star shape).
     Per-chip batch is held constant (weak scaling)."""
+    from distributed_model_parallel_tpu.runtime.platform import force_cpu
+
     if max_devices < 1:
         raise ValueError(f"--max-devices must be >= 1, got {max_devices}")
     force_cpu(max_devices)
@@ -205,11 +271,16 @@ def scaling_table(max_devices: int = 8) -> None:
         state = engine.init_state(jax.random.PRNGKey(0))
         batch = per_chip_batch * n
         images, labels = engine.shard_batch(*_fake_batch(batch))
+        lr = jnp.float32(0.1)
+        for _ in range(2):
+            state, _ = engine.train_step(state, images, labels, lr)
+        jax.block_until_ready(state)
         iters = 10
-        dt = _timed_step_loop(
-            engine, state, images, labels, jnp.float32(0.1),
-            warmup=2, iters=iters,
-        )
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            state, _ = engine.train_step(state, images, labels, lr)
+        jax.block_until_ready(state)
+        dt = time.perf_counter() - t0
         per_chip = batch * iters / dt / n
         rows.append({"chips": n, "img_per_sec_per_chip": round(per_chip, 1)})
     base = rows[0]["img_per_sec_per_chip"]
@@ -228,6 +299,139 @@ def scaling_table(max_devices: int = 8) -> None:
     print(json.dumps(out, indent=2))
 
 
+# -------------------------------------------------------------- parent side
+
+
+_current_child: subprocess.Popen | None = None
+
+
+def _cpu_child_env(n_devices: int = 8) -> dict:
+    """Env for CPU-only children, immune to the TPU tunnel: strips the
+    sitecustomize preload (PYTHONPATH) whose PJRT plugin registration at
+    interpreter start can hang when the tunnel is wedged — observed as a
+    child that dies with zero output."""
+    env = {
+        k: v for k, v in os.environ.items()
+        if k != "PYTHONPATH" and not k.startswith("PALLAS_AXON")
+    }
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = (
+        env.get("XLA_FLAGS", "")
+        + f" --xla_force_host_platform_device_count={n_devices}"
+    ).strip()
+    return env
+
+
+def _kill_child() -> None:
+    global _current_child
+    if _current_child is not None and _current_child.poll() is None:
+        try:
+            os.killpg(_current_child.pid, signal.SIGKILL)
+        except (ProcessLookupError, PermissionError):
+            pass
+    _current_child = None
+
+
+def _spawn(args: list[str], timeout_s: float, env=None):
+    """Run a bench child in its own process group, killing the whole group
+    on timeout (a plain subprocess timeout leaves grandchildren holding
+    the TPU). Returns (rc, stdout, stderr) with rc None on timeout; on
+    timeout the pipes are drained so whatever progress the child DID
+    stream ends up in the diagnostic JSON."""
+    global _current_child
+    child = subprocess.Popen(
+        [sys.executable, os.path.abspath(__file__), *args],
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+        start_new_session=True, env=env,
+    )
+    _current_child = child
+    try:
+        out, err = child.communicate(timeout=max(timeout_s, 10))
+        _current_child = None
+        return child.returncode, out, err
+    except subprocess.TimeoutExpired:
+        _kill_child()
+        try:  # drain the partial output the child wrote before the kill
+            out, err = child.communicate(timeout=10)
+        except Exception:  # noqa: BLE001
+            out, err = "", ""
+        note = f"child killed after {timeout_s:.0f}s timeout"
+        return None, out, (err + "\n" if err else "") + note
+
+
+def _json_line(stdout: str):
+    lines = [l for l in stdout.splitlines() if l.startswith("{")]
+    return lines[-1] if lines else None
+
+
+def main() -> None:
+    start = time.monotonic()
+    deadline = start + TOTAL_BUDGET_S
+
+    def remaining() -> float:
+        return deadline - time.monotonic()
+
+    # --- one patient accelerator child: dial + measure in one process ----
+    accel_timeout = remaining() - CPU_FALLBACK_RESERVE_S
+    accel_err = ""
+    if accel_timeout > 60:
+        log(f"accelerator child gets {accel_timeout:.0f}s")
+        rc, out, err = _spawn(
+            ["--child", "--child-model", "mobilenetv2",
+             "--child-batch", "512", "--child-dtypes", "bfloat16,float32"],
+            accel_timeout,
+        )
+        line = _json_line(out)
+        if rc == 0 and line:
+            parsed = json.loads(line)
+            if parsed.get("platform") != "cpu":
+                print(line, flush=True)
+                return
+            accel_err = "backend fell back to cpu platform"
+            log(accel_err)
+        else:
+            accel_err = (err or out)[-300:].strip()
+            if rc is None and not out:
+                where = (
+                    "during the backend dial (jax.devices)"
+                    if "initializing backend" in (err or "")
+                    else "at interpreter start (PJRT plugin registration)"
+                )
+                accel_err += (
+                    f" — child hung {where}; device tunnel unreachable?"
+                )
+            log(f"accelerator child failed (rc={rc}): {accel_err}")
+    else:
+        accel_err = "no budget left for accelerator child"
+
+    # --- degraded mode: tinycnn on the virtual-CPU mesh, same mechanism --
+    # (full MobileNetV2 takes ~10 min to COMPILE on a 1-core CPU host; a
+    # diagnostic number from the same engine/collective path beats rc=1)
+    cpu_timeout = remaining() - 15
+    if cpu_timeout > 30:
+        rc, out, err = _spawn(
+            ["--child", "--child-cpu", "--child-model", "tinycnn",
+             "--child-batch", "256", "--child-dtypes", "float32"],
+            cpu_timeout, env=_cpu_child_env(),
+        )
+        line = _json_line(out)
+        if rc == 0 and line:
+            parsed = json.loads(line)
+            parsed["vs_baseline"] = 0.0
+            parsed["error"] = (
+                "accelerator unavailable; tinycnn diagnostic on virtual-CPU "
+                f"mesh. accelerator error: {accel_err}"
+            )
+            print(json.dumps(parsed), flush=True)
+            return
+        emit(0.0, 0.0, platform="cpu", model="tinycnn", batch=256,
+             error=f"cpu fallback failed (rc={rc}): {(err or out)[-300:]}; "
+                   f"accelerator error: {accel_err}")
+    else:
+        emit(0.0, 0.0, platform="none", model="mobilenetv2", batch=512,
+             error=f"budget exhausted; accelerator error: {accel_err}")
+
+
 if __name__ == "__main__":
     parser = argparse.ArgumentParser()
     parser.add_argument(
@@ -238,24 +442,50 @@ if __name__ == "__main__":
     parser.add_argument("--max-devices", type=int, default=8)
     parser.add_argument(
         "--child", action="store_true",
-        help="internal: run the accelerator measurement (spawned by main)",
+        help="internal: run a measurement in-process (spawned by main)",
     )
+    parser.add_argument("--child-scaling", action="store_true",
+                        help="internal: run the scaling sweep in-process")
+    parser.add_argument("--child-model", default="mobilenetv2")
+    parser.add_argument("--child-batch", type=int, default=512)
+    parser.add_argument("--child-dtypes", default="bfloat16,float32")
+    parser.add_argument("--child-cpu", action="store_true",
+                        help="internal: force the virtual-CPU mesh")
     args = parser.parse_args()
 
     if args.child:
-        run_child()
+        run_child(args.child_model, args.child_batch,
+                  args.child_dtypes.split(","), cpu=args.child_cpu)
+        sys.exit(0)
+    if args.child_scaling:
+        run_child_scaling(args.max_devices)
         sys.exit(0)
 
     def on_alarm(signum, frame):
-        emit(0.0, "images/sec", 0.0, error="bench watchdog expired")
+        # Final backstop above the deadline bookkeeping: kill the child's
+        # whole process group BEFORE exiting so nothing orphaned keeps the
+        # TPU (ADVICE r2 medium), then still deliver one JSON line, rc 0.
+        _kill_child()
+        emit(0.0, 0.0, error="bench watchdog expired",
+             model="mobilenetv2", batch=512, platform="unknown")
         os._exit(0)
 
     signal.signal(signal.SIGALRM, on_alarm)
-    signal.alarm(TOTAL_BUDGET_S)
+    signal.alarm(TOTAL_BUDGET_S + 30)
     try:
         if args.scaling:
-            scaling_table(args.max_devices)
+            rc, out, err = _spawn(
+                ["--child-scaling", "--max-devices", str(args.max_devices)],
+                TOTAL_BUDGET_S, env=_cpu_child_env(args.max_devices),
+            )
+            if rc == 0 and out.strip():
+                print(out, end="", flush=True)
+            else:
+                emit(0.0, 0.0,
+                     error=f"scaling child failed (rc={rc}): "
+                           f"{(err or out)[-300:]}")
         else:
             main()
     except Exception as e:  # noqa: BLE001 — rc must stay 0 with a JSON line
-        emit(0.0, "images/sec", 0.0, error=f"{type(e).__name__}: {e}")
+        emit(0.0, 0.0, error=f"{type(e).__name__}: {e}",
+             model="mobilenetv2", batch=512)
