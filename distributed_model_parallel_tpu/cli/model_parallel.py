@@ -77,6 +77,10 @@ def build_parser() -> argparse.ArgumentParser:
                         help="use the reference's exact ws=4 stage "
                              "boundaries [3, 9, 15] (requires "
                              "--world-size 4, MobileNetV2)")
+    parser.add_argument("--stage-local-params", action="store_true",
+                        help="store params/optimizer sharded over 'stage' "
+                             "(each device holds ~1/S of the model) "
+                             "instead of replicated")
     add_common_tpu_flags(parser)
     return parser
 
@@ -114,6 +118,7 @@ def main(argv=None) -> dict:
         mesh,
         num_microbatches=args.microbatches,
         compute_dtype=compute_dtype_from_flag(args.dtype),
+        stage_local_params=args.stage_local_params,
     )
     cfg = TrainerConfig(
         epochs=args.epochs,

@@ -98,11 +98,13 @@ def _wire_dtype(avals) -> jnp.dtype:
 def _pack(tree, buf_size: int, dtype=jnp.float32) -> jax.Array:
     """Pytree of arrays -> one flat buffer of `dtype` padded to `buf_size`
     (the wire format between stages; one static ppermute shape for
-    everything)."""
+    everything). Also the storage format for stage-local parameters."""
     flats = [
         leaf.astype(dtype).reshape(-1)
         for leaf in jax.tree_util.tree_leaves(tree)
     ]
+    if not flats:
+        return jnp.zeros((buf_size,), dtype)
     flat = jnp.concatenate(flats) if len(flats) > 1 else flats[0]
     return jnp.zeros((buf_size,), dtype).at[: flat.shape[0]].set(flat)
 
@@ -137,6 +139,16 @@ class PipelineEngine:
     sync_bn: bool = False
     donate: bool = True
     compute_dtype: Any = None  # mixed precision; see DataParallelEngine
+    # Stage-local parameter storage: params / BN state / momentum live as
+    # (S, maxP) f32 arrays sharded over 'stage', so each device STORES
+    # ~1/S of the model instead of all of it — the memory scaling that is
+    # the reason pipeline MP exists (the reference splits the model across
+    # GPUs for exactly this, `model_parallel.py:99-157`). Each device
+    # unpacks only its own stage's slice inside the step; gradients stay
+    # local to their stage's devices (no psum over 'stage' needed).
+    # False keeps the replicated representation (params as a per-stage
+    # tuple of pytrees on every device).
+    stage_local_params: bool = False
 
     def __post_init__(self):
         mesh = self.mesh
@@ -150,6 +162,22 @@ class PipelineEngine:
             )
         self._repl = NamedSharding(mesh, P())
         self._batch = NamedSharding(mesh, P(("data",)))
+
+        # Per-stage param/state avals from an abstract trace of init —
+        # the static metadata both param representations are built from.
+        key_aval = jax.ShapeDtypeStruct((2,), jnp.uint32)
+        self._param_avals, self._state_avals = [], []
+        for stage in self.stages:
+            p_aval, s_aval = jax.eval_shape(stage.init, key_aval)
+            self._param_avals.append(p_aval)
+            self._state_avals.append(s_aval)
+        self._psize = max(
+            (_tree_size(a) for a in self._param_avals), default=1
+        ) or 1
+        self._ssize = max(
+            (_tree_size(a) for a in self._state_avals), default=1
+        ) or 1
+        self._stage_sh = NamedSharding(mesh, P(("stage",)))
 
         donate = (0,) if self.donate else ()
         self.train_step = jax.jit(
@@ -165,15 +193,95 @@ class PipelineEngine:
             p, s = stage.init(jax.random.fold_in(rng, i))
             params.append(p)
             state.append(s)
-        params, state = tuple(params), tuple(state)
-        opt_state = self.optimizer.init(params)
-        ts = TrainState(params, state, opt_state, jnp.zeros((), jnp.int32))
-        return jax.device_put(ts, self._repl)
+        if not self.stage_local_params:
+            params, state = tuple(params), tuple(state)
+            opt_state = self.optimizer.init(params)
+            ts = TrainState(
+                params, state, opt_state, jnp.zeros((), jnp.int32)
+            )
+            return jax.device_put(ts, self._repl)
+        # Stage-local: per-stage flats become rows of (S, maxP) / (S, maxS)
+        # arrays sharded over 'stage'. Rows are staged through host memory
+        # and materialized shard-by-shard (make_array_from_callback) so
+        # peak DEVICE memory is one stage, not the whole model — the point
+        # of this mode is that the whole model doesn't fit per device.
+        flat_p = self._stack_local([_pack(p, self._psize) for p in params])
+        flat_s = self._stack_local([_pack(s, self._ssize) for s in state])
+        opt_state = self.optimizer.init(flat_p)  # zeros_like keeps sharding
+        return TrainState(
+            flat_p, flat_s, opt_state,
+            jax.device_put(jnp.zeros((), jnp.int32), self._repl),
+        )
+
+    def _stack_local(self, rows) -> jax.Array:
+        """[per-stage 1-D rows] -> (S, width) array sharded P('stage'),
+        without ever materializing the full stack on one device."""
+        import numpy as np
+
+        np_rows = np.stack([np.asarray(jax.device_get(r)) for r in rows])
+        return jax.make_array_from_callback(
+            np_rows.shape, self._stage_sh, lambda idx: np_rows[idx]
+        )
+
+    def params_tree(self, ts: TrainState):
+        """The per-stage tuple-of-pytrees view of `ts.params`, whichever
+        representation the engine uses — for checkpoint interop, weight
+        transplant, and tests."""
+        if not self.stage_local_params:
+            return ts.params
+        flat = jax.device_get(ts.params)
+        return tuple(
+            _unpack(flat[i], self._param_avals[i])
+            for i in range(self.num_stages)
+        )
+
+    # ---------------------------------------------- checkpoint canonical
+
+    def to_canonical(self, ts: TrainState) -> TrainState:
+        """TrainState in the layout-independent checkpoint form: params /
+        BN state / momentum as per-stage tuples of pytrees with real layer
+        paths and shapes. Checkpoints written this way are interchangeable
+        between stage_local_params modes (and validate per-layer structure
+        on restore, which a packed (S, maxP) leaf cannot)."""
+        if not self.stage_local_params:
+            return ts
+        flat_m = jax.device_get(ts.opt_state.momentum)
+        momentum = tuple(
+            _unpack(flat_m[i], self._param_avals[i])
+            for i in range(self.num_stages)
+        )
+        state = tuple(
+            _unpack(jax.device_get(ts.model_state)[i], self._state_avals[i])
+            for i in range(self.num_stages)
+        )
+        return TrainState(
+            self.params_tree(ts), state,
+            ts.opt_state._replace(momentum=momentum), ts.step,
+        )
+
+    def from_canonical(self, ts: TrainState) -> TrainState:
+        """Inverse of `to_canonical`: re-pack a canonical TrainState into
+        this engine's runtime layout and placement."""
+        if not self.stage_local_params:
+            return jax.device_put(ts, self._repl)
+        flat_p = self._stack_local(
+            [_pack(p, self._psize) for p in ts.params]
+        )
+        flat_s = self._stack_local(
+            [_pack(s, self._ssize) for s in ts.model_state]
+        )
+        flat_m = self._stack_local(
+            [_pack(m, self._psize) for m in ts.opt_state.momentum]
+        )
+        return TrainState(
+            flat_p, flat_s, ts.opt_state._replace(momentum=flat_m),
+            jax.device_put(jnp.asarray(ts.step), self._repl),
+        )
 
     def shard_batch(self, images, labels):
         return _place_batch((images, labels), self._batch)
 
-    def _stage_avals(self, params, state, x_aval, train: bool):
+    def _stage_avals(self, x_aval, train: bool):
         """(input_avals, output_avals) per stage from an abstract trace —
         the static replacement for the reference's runtime dim/size
         handshake (`distributed_layers.py:40-47`). Stage I/O may be any
@@ -186,7 +294,7 @@ class PipelineEngine:
         for i, stage in enumerate(self.stages):
             out = jax.eval_shape(
                 lambda p, s, x, stage=stage: stage.apply(p, s, x, ctx)[0],
-                params[i], state[i], aval,
+                self._param_avals[i], self._state_avals[i], aval,
             )
             avals.append((aval, out))
             aval = out
@@ -200,6 +308,19 @@ class PipelineEngine:
         mesh = self.mesh
         bn_axis = "data" if self.sync_bn else None
         cdt = self.compute_dtype
+        local = self.stage_local_params
+
+        def stage_params(params, i):
+            """Stage i's param pytree from either representation. In
+            stage-local mode every device holds ONLY its own stage's
+            (1, maxP) slice; the unpack is differentiable, so the grad
+            wrt the flat slice is the full stage-i gradient."""
+            return _unpack(params[0], self._param_avals[i]) if local \
+                else params[i]
+
+        def stage_state(state, i):
+            return _unpack(state[0], self._state_avals[i]) if local \
+                else state[i]
 
         def pipeline_forward(params, model_state, images, labels, step):
             """Runs on ONE device (inside shard_map): the full fill-drain
@@ -216,7 +337,7 @@ class PipelineEngine:
             x_aval = jax.ShapeDtypeStruct(
                 (mb,) + images.shape[1:], images.dtype
             )
-            avals = self._stage_avals(params, model_state, x_aval, train)
+            avals = self._stage_avals(x_aval, train)
             out_leaves = jax.tree_util.tree_leaves(avals[-1][1])
             if len(out_leaves) != 1 or len(out_leaves[0].shape) != 2:
                 raise ValueError(
@@ -241,12 +362,16 @@ class PipelineEngine:
                     else:
                         x = _unpack(buf, in_aval)
                     y, new_si = self.stages[i].apply(
-                        params[i], state[i], x, ctx
+                        stage_params(params, i), stage_state(state, i),
+                        x, ctx,
                     )
                     y_pad = _pack(y, buf_size, wire_dt)
-                    new_state = tuple(
-                        new_si if j == i else state[j] for j in range(S)
-                    )
+                    if local:
+                        new_state = _pack(new_si, self._ssize)[None, :]
+                    else:
+                        new_state = tuple(
+                            new_si if j == i else state[j] for j in range(S)
+                        )
                     return y_pad, new_state
 
                 return branch
@@ -351,13 +476,22 @@ class PipelineEngine:
             }
             return {k: lax.psum(v, "data") for k, v in m.items()}
 
+        # shard_map spec for the TrainState: stage-local params ride the
+        # 'stage' axis (each device gets its (1, maxP) slice); the
+        # replicated representation is a plain P() prefix.
+        if local:
+            st = P(("stage",))
+            ts_spec = TrainState(st, st, st, P())
+        else:
+            ts_spec = P()
+
         if train:
 
             @partial(
                 shard_map,
                 mesh=mesh,
-                in_specs=(P(), P(("data",)), P(("data",)), P()),
-                out_specs=(P(), P()),
+                in_specs=(ts_spec, P(("data",)), P(("data",)), P()),
+                out_specs=(ts_spec, P()),
                 check_vma=False,
             )
             def step(ts: TrainState, images, labels, lr):
@@ -372,14 +506,22 @@ class PipelineEngine:
                 (loss, (logits, new_state, is_last)), grads = (
                     jax.value_and_grad(loss_fn, has_aux=True)(ts.params)
                 )
-                # Stage-i grads are nonzero only on stage-i devices; the
-                # psum over 'stage' + pmean over 'data' is the single fused
-                # all-reduce replacing per-rank optimizers
-                # (`model_parallel.py:105-149`) and the DDP Reducer.
-                grads = jax.tree_util.tree_map(
-                    lambda g: lax.pmean(lax.psum(g, "stage"), "data"), grads
-                )
-                new_state = reassemble_state(new_state, s_idx)
+                if local:
+                    # Each device's flat grad IS its stage's full gradient
+                    # (cotangents crossed stages through the reversed
+                    # ppermutes); only the data-parallel mean remains.
+                    grads = lax.pmean(grads, "data")
+                else:
+                    # Stage-i grads are nonzero only on stage-i devices;
+                    # the psum over 'stage' + pmean over 'data' is the
+                    # single fused all-reduce replacing per-rank
+                    # optimizers (`model_parallel.py:105-149`) and the
+                    # DDP Reducer.
+                    grads = jax.tree_util.tree_map(
+                        lambda g: lax.pmean(lax.psum(g, "stage"), "data"),
+                        grads,
+                    )
+                    new_state = reassemble_state(new_state, s_idx)
                 if not self.sync_bn:
                     new_state = lax.pmean(new_state, "data")
                 params, opt_state = self.optimizer.update(
@@ -396,7 +538,7 @@ class PipelineEngine:
         @partial(
             shard_map,
             mesh=mesh,
-            in_specs=(P(), P(("data",)), P(("data",))),
+            in_specs=(ts_spec, P(("data",)), P(("data",))),
             out_specs=P(),
             check_vma=False,
         )

@@ -109,9 +109,10 @@ class Trainer:
         self.best_acc = 0.0
         self.start_epoch = 0
         if config.resume:
-            self.state, self.best_acc, last_epoch = restore_checkpoint(
-                config.checkpoint_dir, self.state
+            restored, self.best_acc, last_epoch = restore_checkpoint(
+                config.checkpoint_dir, self._to_canonical(self.state)
             )
+            self.state = self._from_canonical(restored)
             self.start_epoch = last_epoch + 1
             self._log_print(
                 f"==> Resumed from checkpoint: epoch {last_epoch}, "
@@ -246,7 +247,7 @@ class Trainer:
                 self._log_print("Saving..")
                 save_checkpoint(
                     cfg.checkpoint_dir,
-                    self.state,
+                    self._to_canonical(self.state),
                     acc=self.best_acc,
                     epoch=epoch,
                 )
@@ -258,6 +259,19 @@ class Trainer:
         }
 
     # ----------------------------------------------------------- helpers
+
+    def _to_canonical(self, state):
+        """Checkpoints are written in the engine's layout-independent
+        canonical form when it defines one (e.g. PipelineEngine's
+        stage-local packed params -> per-stage pytrees with real layer
+        paths), so checkpoints stay interchangeable across engine storage
+        layouts and validate per-layer structure on restore."""
+        fn = getattr(self.engine, "to_canonical", None)
+        return fn(state) if fn is not None else state
+
+    def _from_canonical(self, state):
+        fn = getattr(self.engine, "from_canonical", None)
+        return fn(state) if fn is not None else state
 
     def _finalize(
         self, sums, n_batches: int, wall: float, data_time: float

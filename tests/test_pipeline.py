@@ -254,3 +254,143 @@ def test_pipeline_bn_microbatch_state_and_grads_match_sequential(pp_mesh):
                 np.asarray(a), np.asarray(b), rtol=2e-4, atol=2e-5,
                 err_msg=f"params stage {i} {jax.tree_util.keystr(path)}",
             )
+
+
+# ---------------------------------------------------------------------------
+# Stage-local parameter storage (VERDICT r2 item 5): params / BN state /
+# momentum sharded over 'stage' so each device stores ~1/S of the model.
+# ---------------------------------------------------------------------------
+
+
+def _run_steps(engine, images, labels, n=3, lr=0.1):
+    ts = engine.init_state(jax.random.PRNGKey(1))
+    sb = engine.shard_batch(images, labels)
+    losses = []
+    for _ in range(n):
+        ts, m = engine.train_step(ts, *sb, jnp.float32(lr))
+        losses.append(float(m["loss_sum"]) / float(m["count"]))
+    return ts, losses
+
+
+@pytest.mark.parametrize("stages_fn", [tiny_stages, bn_stages])
+def test_stage_local_matches_replicated(pp_mesh, stages_fn):
+    """stage_local_params=True must be a pure storage-layout change: the
+    training trajectory equals the replicated representation's (same init
+    seed), including BN running stats."""
+    stages = stages_fn()
+    images, labels = batch(n=16, hw=8, seed=5)
+    repl = PipelineEngine(
+        stages, SGD(momentum=0.9), pp_mesh, num_microbatches=2,
+        donate=False,
+    )
+    local = PipelineEngine(
+        stages, SGD(momentum=0.9), pp_mesh, num_microbatches=2,
+        donate=False, stage_local_params=True,
+    )
+    ts_r, losses_r = _run_steps(repl, images, labels)
+    ts_l, losses_l = _run_steps(local, images, labels)
+    np.testing.assert_allclose(losses_l, losses_r, rtol=1e-5)
+    got = local.params_tree(ts_l)
+    for i, want in enumerate(repl.params_tree(ts_r)):
+        for (path, a), b in zip(
+            jax.tree_util.tree_leaves_with_path(want),
+            jax.tree_util.tree_leaves(got[i]),
+        ):
+            np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), rtol=2e-4, atol=2e-5,
+                err_msg=f"stage {i} {jax.tree_util.keystr(path)}",
+            )
+
+
+def test_stage_local_memory_is_one_over_s(pp_mesh):
+    """Each device's addressable params shard is the (1, maxP) slice —
+    bounded by the LARGEST stage, not the sum of all stages. This is the
+    memory scaling that makes pipeline MP a memory tool (the reason the
+    reference split its model across GPUs, `model_parallel.py:99-157`)."""
+    stages = tiny_stages()
+    engine = PipelineEngine(
+        stages, SGD(), pp_mesh, stage_local_params=True
+    )
+    ts = engine.init_state(jax.random.PRNGKey(0))
+    S = engine.num_stages
+    assert ts.params.shape == (S, engine._psize)
+    for shard in ts.params.addressable_shards:
+        assert shard.data.shape == (1, engine._psize)
+    # The per-device slice is strictly smaller than the whole model.
+    total_params = sum(
+        np.prod(l.shape)
+        for a in engine._param_avals
+        for l in jax.tree_util.tree_leaves(a)
+    )
+    assert engine._psize < total_params
+    # Momentum rides the same layout.
+    assert ts.opt_state.momentum.shape == (S, engine._psize)
+
+
+def test_stage_local_eval_matches_sequential(pp_mesh):
+    stages = tiny_stages()
+    engine = PipelineEngine(
+        stages, SGD(), pp_mesh, num_microbatches=2,
+        stage_local_params=True,
+    )
+    ts = engine.init_state(jax.random.PRNGKey(0))
+    images, labels = batch()
+    m = engine.eval_step(ts, *engine.shard_batch(images, labels))
+    params = engine.params_tree(ts)
+    state = tuple(
+        stage.init(jax.random.PRNGKey(9))[1] for stage in stages
+    )  # stateless stages: empty dicts in the right structure
+    loss, logits, _ = seq_reference(
+        stages, params, state, images, labels, train=False
+    )
+    np.testing.assert_allclose(
+        float(m["loss_sum"]) / float(m["count"]), float(loss),
+        rtol=1e-5, atol=1e-6,
+    )
+
+
+def test_stage_local_checkpoint_interop(pp_mesh, tmp_path):
+    """Checkpoints are written in canonical per-stage-pytree form, so a
+    run with stage_local_params=True can be resumed without the flag and
+    vice versa (layout is a runtime choice, not a checkpoint format)."""
+    from distributed_model_parallel_tpu.training.checkpoint import (
+        restore_checkpoint,
+        save_checkpoint,
+    )
+
+    stages = bn_stages()
+    images, labels = batch(n=16, hw=8, seed=5)
+    local = PipelineEngine(
+        stages, SGD(), pp_mesh, num_microbatches=2, donate=False,
+        stage_local_params=True,
+    )
+    ts_l, _ = _run_steps(local, images, labels, n=2)
+    save_checkpoint(
+        str(tmp_path), local.to_canonical(ts_l), acc=50.0, epoch=1
+    )
+
+    repl = PipelineEngine(
+        stages, SGD(), pp_mesh, num_microbatches=2, donate=False,
+    )
+    ts_r = repl.init_state(jax.random.PRNGKey(42))  # different init
+    restored, acc, epoch = restore_checkpoint(
+        str(tmp_path), repl.to_canonical(ts_r)
+    )
+    ts_r2 = repl.from_canonical(restored)
+    assert acc == 50.0 and epoch == 1
+    want = local.params_tree(ts_l)
+    for i, got in enumerate(repl.params_tree(ts_r2)):
+        for a, b in zip(
+            jax.tree_util.tree_leaves(want[i]),
+            jax.tree_util.tree_leaves(got),
+        ):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b))
+    # And back: the replicated checkpoint loads into a stage-local engine.
+    restored2, _, _ = restore_checkpoint(
+        str(tmp_path), local.to_canonical(local.init_state(jax.random.PRNGKey(7)))
+    )
+    ts_l2 = local.from_canonical(restored2)
+    step_out, _ = local.train_step(
+        ts_l2, *local.shard_batch(images, labels), jnp.float32(0.05)
+    )
+    assert int(step_out.step) == int(ts_l.step) + 1
