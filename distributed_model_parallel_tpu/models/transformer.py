@@ -7,10 +7,13 @@ long-sequence models as first-class. Encoder layers operate on a
 states through `sequential`, which keeps the stack splittable into
 pipeline stages exactly like the CNN families.
 
-Attention math routes through `ops.attention.dot_product_attention`, the
-swap point for ring attention ('seq'-sharded KV rotation) and the Pallas
-flash kernel. Head-dimension projections are single fused (D, 3D)/(D, D)
-matmuls — the layout tensor parallelism shards on the 'model' axis.
+Attention math routes through the `attention_fn` parameter (default
+`ops.attention.dot_product_attention`); pass
+`ops.ring_attention.ring_attention` / `ulysses_attention` to run the
+stack sequence-parallel (tests/test_sequence_parallel.py). Head-dimension
+projections are single fused (D, 3D)/(D, D) matmuls — the layout
+`parallel.tensor_parallel.TensorParallelEngine` shards on the 'model'
+axis via `MEGATRON_RULES`.
 """
 
 from __future__ import annotations

@@ -1,3 +1,12 @@
 """Compute ops: attention cores (reference-free — the reference has no
-attention model; BERT-base is demanded by BASELINE.json's configs), and
-Pallas TPU kernels for the hot paths."""
+attention model; BERT-base is demanded by BASELINE.json's configs) and
+their sequence-parallel variants (ring attention over ppermute, Ulysses
+all-to-all)."""
+
+from distributed_model_parallel_tpu.ops.attention import (  # noqa: F401
+    dot_product_attention,
+)
+from distributed_model_parallel_tpu.ops.ring_attention import (  # noqa: F401
+    ring_attention,
+    ulysses_attention,
+)

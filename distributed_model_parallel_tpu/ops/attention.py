@@ -1,10 +1,10 @@
 """Scaled dot-product attention cores.
 
 The plain XLA version lives here as the numerical reference and CPU/test
-path; it is written so the sequence-parallel engines can swap in ring
-attention (KV rotating over the 'seq' axis) or a Pallas flash kernel
-without touching the transformer layers: everything routes through
-`dot_product_attention(q, k, v, mask)`.
+path; the sequence-parallel variants — `ops.ring_attention.ring_attention`
+(KV rotating over the 'seq' axis) and `ulysses_attention` (all-to-all
+head/sequence re-shard) — are drop-in replacements, because everything
+routes through the `attention_fn(q, k, v, mask)` signature.
 
 Shapes follow the TPU-friendly convention (B, T, H, Dh) — batch, sequence,
 heads, head_dim — so the head axis is adjacent to the feature axis XLA

@@ -1,0 +1,153 @@
+"""Sequence/context parallelism: ring attention + Ulysses all-to-all.
+
+Long-context attention on a `'seq'`-sharded mesh axis. Both ops are
+drop-in `attention_fn`s for the transformer layers
+(`models/transformer.py`) when the encoder runs inside `shard_map` with
+activations sharded over the sequence dimension — the TPU-native
+equivalents of the GPU world's Ring Attention (Liu et al.) and
+DeepSpeed-Ulysses. Absent from the reference (SURVEY.md §2.3: no
+attention models at all); first-class here because long-context is part
+of this framework's capability surface.
+
+* `ring_attention`: K/V (+ key mask) blocks rotate around the ring via
+  `lax.ppermute` while each device accumulates its local queries' output
+  with the online-softmax (flash) recurrence in f32. Memory per device is
+  O(T/N · T/N) per block pair instead of O(T²); the N permute hops ride
+  ICI and overlap with the einsums. Exact — not an approximation.
+* `ulysses_attention`: two `lax.all_to_all`s re-shard (B, T/N, H, dh) ->
+  (B, T, H/N, dh), run ordinary attention with full sequence per head
+  locally, and shard back. One collective pair per layer; requires
+  H % N == 0.
+
+Both compute in f32 and cast back to the input dtype (bf16-safe), match
+`dot_product_attention` numerically (tests/test_sequence_parallel.py,
+forward AND gradients), and support the (B, Tkv) key-validity mask.
+Causal masking is not implemented (the model zoo's flagship transformer
+is BERT — bidirectional); a causal variant adds a block-index predicate
+to the same recurrence.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from distributed_model_parallel_tpu.ops.attention import (
+    dot_product_attention,
+)
+
+_NEG = jnp.finfo(jnp.float32).min
+
+
+def ring_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    mask: Optional[jax.Array] = None,
+    *,
+    axis_name: str = "seq",
+    scale: Optional[float] = None,
+) -> jax.Array:
+    """Exact attention over a ring of sequence shards.
+
+    Call inside `shard_map` with q/k/v sharded over `axis_name` on the
+    sequence axis: local shapes (B, T/N, H, dh), `mask` (B, T/N) key
+    validity. Returns the local queries' attention over the FULL global
+    key/value sequence.
+    """
+    dh = q.shape[-1]
+    scale = scale if scale is not None else 1.0 / math.sqrt(dh)
+    b, tq, h, _ = q.shape
+    n = lax.psum(1, axis_name)  # static ring size
+    qf = q.astype(jnp.float32) * scale
+    kb = k.astype(jnp.float32)
+    vb = v.astype(jnp.float32)
+    maskb = (
+        mask if mask is not None
+        else jnp.ones(k.shape[:2], dtype=jnp.bool_)
+    )
+    perm = [(i, (i + 1) % n) for i in range(n)]
+
+    # Online-softmax accumulators (flash recurrence), all f32.
+    m0 = jnp.full((b, h, tq), _NEG, jnp.float32)       # running max
+    l0 = jnp.zeros((b, h, tq), jnp.float32)            # running denom
+    o0 = jnp.zeros((b, tq, h, dh), jnp.float32)        # running numerator
+
+    def accumulate(acc, kb, vb, maskb):
+        m, l, o = acc
+        logits = jnp.einsum("bqhd,bkhd->bhqk", qf, kb)
+        logits = jnp.where(maskb[:, None, None, :], logits, _NEG)
+        m_new = jnp.maximum(m, jnp.max(logits, axis=-1))
+        # exp(_NEG - m_new) underflows to 0 for any finite m_new; a fully
+        # masked ring (pad-only rows) keeps l == 0 and is guarded below.
+        p = jnp.exp(logits - m_new[..., None])
+        corr = jnp.exp(m - m_new)
+        l = l * corr + jnp.sum(p, axis=-1)
+        o = o * jnp.transpose(corr, (0, 2, 1))[..., None] + jnp.einsum(
+            "bhqk,bkhd->bqhd", p, vb
+        )
+        return m_new, l, o
+
+    def body(_, carry):
+        # Rotate THEN accumulate: the local block is consumed before the
+        # loop, so exactly n-1 ring hops happen in total (a rotate-last
+        # loop would pay one extra full K/V transfer whose result is
+        # discarded — pure ICI waste on the long-context hot path).
+        acc, kb, vb, maskb = carry
+        kb, vb, maskb = (
+            lax.ppermute(x, axis_name, perm) for x in (kb, vb, maskb)
+        )
+        return accumulate(acc, kb, vb, maskb), kb, vb, maskb
+
+    acc = accumulate((m0, l0, o0), kb, vb, maskb)  # local block first
+    (m, l, o), *_ = lax.fori_loop(0, n - 1, body, (acc, kb, vb, maskb))
+    denom = jnp.where(l > 0, l, 1.0)
+    out = o / jnp.transpose(denom, (0, 2, 1))[..., None]
+    return out.astype(q.dtype)
+
+
+def ulysses_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    mask: Optional[jax.Array] = None,
+    *,
+    axis_name: str = "seq",
+    scale: Optional[float] = None,
+) -> jax.Array:
+    """All-to-all sequence parallelism (DeepSpeed-Ulysses layout swap).
+
+    Call inside `shard_map` with q/k/v sharded over `axis_name` on the
+    sequence axis, heads divisible by the axis size: re-shards to
+    head-parallel, runs ordinary full-sequence attention locally, and
+    re-shards back to sequence-parallel.
+    """
+    n = lax.psum(1, axis_name)
+    h = q.shape[2]
+    if h % n:
+        raise ValueError(
+            f"ulysses needs heads ({h}) divisible by '{axis_name}' "
+            f"axis size ({n})"
+        )
+
+    def to_heads(x):  # (B, T/N, H, dh) -> (B, T, H/N, dh)
+        return lax.all_to_all(
+            x, axis_name, split_axis=2, concat_axis=1, tiled=True
+        )
+
+    def to_seq(x):  # inverse
+        return lax.all_to_all(
+            x, axis_name, split_axis=1, concat_axis=2, tiled=True
+        )
+
+    full_mask = None
+    if mask is not None:
+        full_mask = lax.all_gather(mask, axis_name, axis=1, tiled=True)
+    out = dot_product_attention(
+        to_heads(q), to_heads(k), to_heads(v), full_mask, scale=scale
+    )
+    return to_seq(out)

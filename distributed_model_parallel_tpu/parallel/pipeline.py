@@ -109,6 +109,22 @@ def _pack(tree, buf_size: int, dtype=jnp.float32) -> jax.Array:
     return jnp.zeros((buf_size,), dtype).at[: flat.shape[0]].set(flat)
 
 
+def _pack_np(tree, buf_size: int):
+    """Host-side `_pack` (f32 numpy): used when staging per-stage rows
+    through host memory must not create device buffers."""
+    import numpy as np
+
+    flats = [
+        np.asarray(leaf, np.float32).ravel()
+        for leaf in jax.tree_util.tree_leaves(tree)
+    ]
+    row = np.zeros((buf_size,), np.float32)
+    if flats:
+        flat = np.concatenate(flats) if len(flats) > 1 else flats[0]
+        row[: flat.shape[0]] = flat
+    return row
+
+
 def _unpack(buf: jax.Array, aval_tree):
     """Inverse of `_pack` given the target aval pytree."""
     leaves, treedef = jax.tree_util.tree_flatten(aval_tree)
@@ -188,12 +204,12 @@ class PipelineEngine:
     # ------------------------------------------------------------ setup
 
     def init_state(self, rng: jax.Array) -> TrainState:
-        params, state = [], []
-        for i, stage in enumerate(self.stages):
-            p, s = stage.init(jax.random.fold_in(rng, i))
-            params.append(p)
-            state.append(s)
         if not self.stage_local_params:
+            params, state = [], []
+            for i, stage in enumerate(self.stages):
+                p, s = stage.init(jax.random.fold_in(rng, i))
+                params.append(p)
+                state.append(s)
             params, state = tuple(params), tuple(state)
             opt_state = self.optimizer.init(params)
             ts = TrainState(
@@ -201,24 +217,34 @@ class PipelineEngine:
             )
             return jax.device_put(ts, self._repl)
         # Stage-local: per-stage flats become rows of (S, maxP) / (S, maxS)
-        # arrays sharded over 'stage'. Rows are staged through host memory
-        # and materialized shard-by-shard (make_array_from_callback) so
-        # peak DEVICE memory is one stage, not the whole model — the point
-        # of this mode is that the whole model doesn't fit per device.
-        flat_p = self._stack_local([_pack(p, self._psize) for p in params])
-        flat_s = self._stack_local([_pack(s, self._ssize) for s in state])
+        # arrays sharded over 'stage'. Each stage is initialized, moved to
+        # HOST memory, and packed there before the next stage initializes
+        # (so at most ONE stage's params are device-resident at a time),
+        # then the stacked array materializes shard-by-shard
+        # (make_array_from_callback) — the point of this mode is that the
+        # whole model doesn't fit per device, so init must never assemble
+        # it on one.
+        p_rows, s_rows = [], []
+        for i, stage in enumerate(self.stages):
+            p, s = stage.init(jax.random.fold_in(rng, i))
+            p_rows.append(_pack_np(jax.device_get(p), self._psize))
+            s_rows.append(_pack_np(jax.device_get(s), self._ssize))
+            del p, s
+        flat_p = self._stack_local(p_rows)
+        flat_s = self._stack_local(s_rows)
         opt_state = self.optimizer.init(flat_p)  # zeros_like keeps sharding
         return TrainState(
             flat_p, flat_s, opt_state,
             jax.device_put(jnp.zeros((), jnp.int32), self._repl),
         )
 
-    def _stack_local(self, rows) -> jax.Array:
-        """[per-stage 1-D rows] -> (S, width) array sharded P('stage'),
-        without ever materializing the full stack on one device."""
+    def _stack_local(self, np_rows) -> jax.Array:
+        """[per-stage 1-D host rows] -> (S, width) array sharded
+        P('stage'), materialized shard-by-shard so the full stack never
+        exists on one device."""
         import numpy as np
 
-        np_rows = np.stack([np.asarray(jax.device_get(r)) for r in rows])
+        np_rows = np.stack(np_rows)
         return jax.make_array_from_callback(
             np_rows.shape, self._stage_sh, lambda idx: np_rows[idx]
         )
@@ -250,8 +276,9 @@ class PipelineEngine:
             _unpack(flat_m[i], self._param_avals[i])
             for i in range(self.num_stages)
         )
+        flat_s = jax.device_get(ts.model_state)
         state = tuple(
-            _unpack(jax.device_get(ts.model_state)[i], self._state_avals[i])
+            _unpack(flat_s[i], self._state_avals[i])
             for i in range(self.num_stages)
         )
         return TrainState(
@@ -265,13 +292,13 @@ class PipelineEngine:
         if not self.stage_local_params:
             return jax.device_put(ts, self._repl)
         flat_p = self._stack_local(
-            [_pack(p, self._psize) for p in ts.params]
+            [_pack_np(p, self._psize) for p in ts.params]
         )
         flat_s = self._stack_local(
-            [_pack(s, self._ssize) for s in ts.model_state]
+            [_pack_np(s, self._ssize) for s in ts.model_state]
         )
         flat_m = self._stack_local(
-            [_pack(m, self._psize) for m in ts.opt_state.momentum]
+            [_pack_np(m, self._psize) for m in ts.opt_state.momentum]
         )
         return TrainState(
             flat_p, flat_s, ts.opt_state._replace(momentum=flat_m),

@@ -1,0 +1,171 @@
+"""Tensor parallelism over the `'model'` mesh axis — GSPMD style.
+
+The reference has no tensor parallelism (SURVEY.md §2.3: absent); this
+engine exists because the framework treats the `'model'` axis as
+first-class (`runtime/mesh.py`). The design is deliberately NOT a
+Megatron-style hand-written f/g collective pair: on TPU the idiomatic
+mechanism is sharding ANNOTATIONS — place the Megatron layout on the
+weight pytree and let XLA's SPMD partitioner insert the all-reduces the
+f/g autograd functions hand-code on GPU:
+
+    column-parallel (qkv / ffn-in):  W (D, kD)  -> P(None, 'model')
+    row-parallel    (attn-out / ffn-out): W (kD, D) -> P('model', None)
+    column-parallel bias (kD,)       -> P('model')
+    everything else (LN, embeddings, head) replicated -> P()
+
+The partitioner propagates: activations after a column-parallel matmul
+are head/feature-sharded, the attention einsum runs head-sharded, and the
+row-parallel matmul produces the partial sums whose psum over 'model' XLA
+inserts exactly where Megatron's `g` function calls all_reduce. Gradient
+collectives come out of the transpose automatically.
+
+Composes with data parallelism on a (data, model) mesh: batch sharded
+over 'data', weights over 'model', one jit program for both.
+
+`MEGATRON_RULES` matches the transformer/BERT layer tree
+(`models/transformer.py`, `models/bert.py`); `rules` accepts any
+(path-regex, PartitionSpec) list for other model families.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import re
+from typing import Any, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from distributed_model_parallel_tpu.models.layers import Context, Layer
+from distributed_model_parallel_tpu.parallel.data_parallel import (
+    TrainState,
+    _cast_input,
+    _metrics,
+    _place_batch,
+)
+from distributed_model_parallel_tpu.training.checkpoint import _path_str
+from distributed_model_parallel_tpu.training.metrics import cross_entropy
+from distributed_model_parallel_tpu.training.optim import SGD
+
+# Megatron sharding layout for the transformer block tree
+# (models/transformer.py param paths: attn.qkv/attn.out, ffn.in/ffn.out).
+MEGATRON_RULES: Tuple[Tuple[str, P], ...] = (
+    (r"attn/qkv/w$", P(None, "model")),
+    (r"attn/qkv/b$", P("model")),
+    (r"attn/out/w$", P("model", None)),
+    (r"ffn/in/w$", P(None, "model")),
+    (r"ffn/in/b$", P("model")),
+    (r"ffn/out/w$", P("model", None)),
+)
+
+
+def shard_specs(params, rules: Sequence[Tuple[str, P]]):
+    """Pytree of PartitionSpecs for `params`: first rule whose regex
+    matches the 'a/b/c' path wins; unmatched leaves are replicated."""
+    compiled = [(re.compile(pat), spec) for pat, spec in rules]
+
+    def spec_of(path, leaf):
+        s = _path_str(path)
+        for pat, spec in compiled:
+            if pat.search(s):
+                return spec
+        return P()
+
+    return jax.tree_util.tree_map_with_path(spec_of, params)
+
+
+@dataclasses.dataclass
+class TensorParallelEngine:
+    """GSPMD tensor(+data) parallelism: weights sharded over 'model' by
+    path rules, batch sharded over 'data', XLA inserts the Megatron
+    collectives. API-compatible with the other engines (train_step /
+    eval_step / shard_batch / init_state)."""
+
+    model: Layer
+    optimizer: SGD
+    mesh: Mesh
+    rules: Sequence[Tuple[str, P]] = MEGATRON_RULES
+    donate: bool = True
+    compute_dtype: Any = None  # see DataParallelEngine
+
+    def __post_init__(self):
+        mesh = self.mesh
+        if "model" not in mesh.axis_names:
+            raise ValueError("tensor-parallel mesh needs a 'model' axis")
+        self._repl = NamedSharding(mesh, P())
+        self._batch = NamedSharding(mesh, P(("data",)))
+        cdt = self.compute_dtype
+
+        def train_step(ts: TrainState, inputs, labels, lr):
+            rng = jax.random.fold_in(jax.random.PRNGKey(0), ts.step)
+            inputs_c = _cast_input(inputs, cdt)
+
+            def loss_fn(params, model_state):
+                logits, new_state = self.model.apply(
+                    params, model_state, inputs_c,
+                    Context(train=True, rng=rng, dtype=cdt),
+                )
+                loss = cross_entropy(logits, labels)
+                return loss, (new_state, logits)
+
+            (loss, (new_state, logits)), grads = jax.value_and_grad(
+                loss_fn, has_aux=True
+            )(ts.params, ts.model_state)
+            params, opt_state = self.optimizer.update(
+                ts.params, ts.opt_state, grads, lr
+            )
+            new_ts = TrainState(params, new_state, opt_state, ts.step + 1)
+            return new_ts, _metrics(loss, logits, labels)
+
+        def eval_step(ts: TrainState, inputs, labels):
+            logits, _ = self.model.apply(
+                ts.params, ts.model_state, _cast_input(inputs, cdt),
+                Context(train=False, dtype=cdt),
+            )
+            loss = cross_entropy(logits, labels)
+            return _metrics(loss, logits, labels)
+
+        # State shardings are fixed by the rules and the model structure
+        # (known from an abstract trace of init); jit pins them in/out so
+        # the partitioner keeps weights resident in their 'model' shards
+        # across steps (no per-step resharding).
+        key_aval = jax.ShapeDtypeStruct((2,), jnp.uint32)
+        p_aval, s_aval = jax.eval_shape(self.model.init, key_aval)
+        param_sh = jax.tree_util.tree_map(
+            lambda spec: NamedSharding(mesh, spec),
+            shard_specs(p_aval, self.rules),
+            is_leaf=lambda x: isinstance(x, P),
+        )
+        self._state_sh = TrainState(
+            param_sh,
+            jax.tree_util.tree_map(lambda _: self._repl, s_aval),
+            jax.eval_shape(self.optimizer.init, p_aval)._replace(
+                momentum=param_sh
+            ),
+            self._repl,
+        )
+        sh = self._state_sh
+        donate = (0,) if self.donate else ()
+        self.train_step = jax.jit(
+            train_step,
+            in_shardings=(sh, self._batch, self._batch, None),
+            out_shardings=(sh, self._repl),
+            donate_argnums=donate,
+        )
+        self.eval_step = jax.jit(
+            eval_step,
+            in_shardings=(sh, self._batch, self._batch),
+            out_shardings=self._repl,
+        )
+
+    def init_state(self, rng: jax.Array) -> TrainState:
+        params, model_state = self.model.init(rng)
+        opt_state = self.optimizer.init(params)
+        ts = TrainState(
+            params, model_state, opt_state, jnp.zeros((), jnp.int32)
+        )
+        return jax.device_put(ts, self._state_sh)
+
+    def shard_batch(self, inputs, labels):
+        return _place_batch((inputs, labels), self._batch)
