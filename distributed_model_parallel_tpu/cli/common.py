@@ -74,6 +74,7 @@ def build_loaders(
     val_batch_size: int | None = None,
     augment: bool = True,
     seed: int = 0,
+    workers: int = 1,
 ):
     """(train_loader, val_loader, num_classes) with per-host sharding —
     the DistributedSampler the reference lacks (`utils.py:21`).
@@ -104,6 +105,7 @@ def build_loaders(
         seed=seed,
         process_index=jax.process_index(),
         process_count=jax.process_count(),
+        workers=workers,
     )
     val = Loader(
         val_ds,
@@ -115,6 +117,7 @@ def build_loaders(
         process_index=jax.process_index(),
         process_count=jax.process_count(),
         drop_last=False,
+        workers=workers,
     )
     return train, val, train_ds.num_classes
 

@@ -61,6 +61,8 @@ def build_parser() -> argparse.ArgumentParser:
     parser.add_argument("--wd", "--weight-decay", default=1e-4, type=float,
                         dest="weight_decay")
     parser.add_argument("--momentum", default=0.9, type=float)
+    parser.add_argument("-j", "--workers", default=1, type=int,
+                        help="native augmentation thread-pool size")
     # -- TPU-native additions --------------------------------------------
     parser.add_argument("--engine", default="gspmd", choices=("gspmd", "ddp"),
                         help="gspmd: compiler-partitioned (nn.DataParallel "
@@ -81,6 +83,7 @@ def main(argv=None) -> dict:
     train, val, num_classes = build_loaders(
         args.dataset_type, args.data, args.batch_size,
         val_batch_size=args.val_batch_size,
+        workers=args.workers,
     )
     model = build_model(args.model, num_classes)
     opt = SGD(momentum=args.momentum, weight_decay=args.weight_decay)

@@ -64,8 +64,9 @@ def build_parser() -> argparse.ArgumentParser:
                         dest="dataset_type")
     parser.add_argument("-b", "--batch-size", default=512, type=int)
     parser.add_argument("-j", "--workers", default=12, type=int,
-                        help="kept for launch-line compatibility; the "
-                             "input pipeline is vectorized, not threaded")
+                        help="native augmentation thread-pool size "
+                             "(reference `-j`); batches are staged ahead "
+                             "by the loader's prefetch thread either way")
     parser.add_argument("--wd", "--weight-decay", default=1e-4, type=float,
                         dest="weight_decay")
     parser.add_argument("--momentum", default=0.9, type=float)
@@ -108,6 +109,7 @@ def main(argv=None) -> dict:
     )
     train, val, num_classes = build_loaders(
         args.dataset_type, args.data, args.batch_size,
+        workers=args.workers,
     )
     stages = build_stages(
         args.model, args.world_size, num_classes, args.reference_split

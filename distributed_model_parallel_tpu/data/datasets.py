@@ -42,6 +42,42 @@ class ArrayDataset:
     def __len__(self) -> int:
         return len(self.labels)
 
+    def gather(self, idx) -> Tuple[np.ndarray, np.ndarray]:
+        return self.images[idx], self.labels[idx]
+
+
+@dataclasses.dataclass
+class LazyImageFolder:
+    """Disk-backed ImageFolder split: holds paths + labels, decodes only
+    the indices a batch asks for (`gather`). This is what lets the input
+    pipeline hold ImageNet-scale trees without decoding the world up
+    front; combined with the Loader's prefetch thread the decode overlaps
+    the device step."""
+
+    paths: list
+    labels: np.ndarray
+    num_classes: int
+    image_size: int = 224
+
+    def __len__(self) -> int:
+        return len(self.labels)
+
+    def gather(self, idx) -> Tuple[np.ndarray, np.ndarray]:
+        from PIL import Image  # lazy; PIL ships with the torch stack
+
+        images = np.empty(
+            (len(idx), self.image_size, self.image_size, 3), np.uint8
+        )
+        for row, i in enumerate(np.asarray(idx)):
+            with Image.open(self.paths[i]) as im:
+                images[row] = np.asarray(
+                    im.convert("RGB").resize(
+                        (self.image_size, self.image_size)
+                    ),
+                    np.uint8,
+                )
+        return images, self.labels[idx]
+
 
 def synthetic(
     num_examples: int = 2048,
@@ -103,13 +139,21 @@ def cifar10(root: str = "./data", *, fallback_synthetic: bool = True):
     return ArrayDataset(xtr, ytr, 10), ArrayDataset(xte, yte, 10)
 
 
-def image_folder(root: str, split_dirs=("train", "val"), image_size: int = 224):
-    """ImageFolder-style tree → ArrayDataset pair ('Imagenet'/'Place365'
-    types, `dataset_collection.py:36-47,66-69`). Decoding uses torch's
-    bundled PIL; intended for small/local trees — the 64-chip-rate ImageNet
-    pipeline is the C++ native loader's job (native/)."""
-    from PIL import Image  # lazy; PIL ships with the baked-in torch stack
+_IMG_EXTS = {
+    ".jpg", ".jpeg", ".png", ".bmp", ".gif", ".webp", ".ppm", ".pgm",
+    ".tif", ".tiff",
+}
 
+
+def image_folder(root: str, split_dirs=("train", "val"), image_size: int = 224,
+                 *, lazy: bool = True):
+    """ImageFolder-style tree ('Imagenet'/'Place365' types,
+    `dataset_collection.py:36-47,66-69`). `lazy=True` (default) returns
+    `LazyImageFolder` splits that decode per batch on demand — the
+    chip-rate path for large trees; `lazy=False` eagerly decodes into an
+    in-memory `ArrayDataset` (handy for small fixtures/tests). Decoding
+    uses torch's bundled PIL; the batched crop/flip/normalize hot loop is
+    the C++ `native/` module either way."""
     out = []
     for split in split_dirs:
         base = os.path.join(root, split)
@@ -118,19 +162,24 @@ def image_folder(root: str, split_dirs=("train", "val"), image_size: int = 224):
             if os.path.isdir(os.path.join(base, d))
         )
         idx = {c: i for i, c in enumerate(classes)}
-        images, labels = [], []
+        paths, labels = [], []
         for c in classes:
             cdir = os.path.join(base, c)
             for fname in sorted(os.listdir(cdir)):
-                with Image.open(os.path.join(cdir, fname)) as im:
-                    im = im.convert("RGB").resize((image_size, image_size))
-                    images.append(np.asarray(im, np.uint8))
+                # Extension filter (torchvision ImageFolder semantics):
+                # a stray .DS_Store / checksum file must not become a
+                # mid-epoch decode error hours into a lazy run.
+                if os.path.splitext(fname)[1].lower() not in _IMG_EXTS:
+                    continue
+                paths.append(os.path.join(cdir, fname))
                 labels.append(idx[c])
-        out.append(
-            ArrayDataset(
-                np.stack(images), np.asarray(labels, np.int64), len(classes)
-            )
+        ds = LazyImageFolder(
+            paths, np.asarray(labels, np.int64), len(classes), image_size
         )
+        if not lazy:
+            images, lab = ds.gather(np.arange(len(ds)))
+            ds = ArrayDataset(images, lab, ds.num_classes)
+        out.append(ds)
     return tuple(out)
 
 
