@@ -50,6 +50,12 @@ def build_parser() -> argparse.ArgumentParser:
     parser.add_argument("--lr", default=0.4, type=float, help="learning rate")
     parser.add_argument("--resume", "-r", action="store_true",
                         help="resume from checkpoint")
+    parser.add_argument("--finetune", default=None, metavar="CKPT",
+                        help="transplant torch MobileNetV2 weights "
+                             "(reference checkpoint format, .pth/.npz; "
+                             "module.* prefixes and the {'net': ...} "
+                             "wrapper handled) before training — the "
+                             "reference's finetune path (Readme.md:200-205)")
     # -- reference hard-codes surfaced as flags --------------------------
     parser.add_argument("-b", "--batch-size", default=512, type=int,
                         help="global batch size (reference: 512)")
@@ -76,6 +82,23 @@ def build_parser() -> argparse.ArgumentParser:
 
 def main(argv=None) -> dict:
     args = build_parser().parse_args(argv)
+    if args.finetune:
+        # Fail fast (before datasets/engine/trainer build): typo'd paths
+        # or unsupported model families should not cost a download first.
+        import os
+
+        if args.resume:
+            raise SystemExit(
+                "--finetune conflicts with --resume: resume restores the "
+                "full training state; drop one of the flags"
+            )
+        if args.model != "mobilenetv2":
+            raise SystemExit(
+                "--finetune supports the BN MobileNetV2 ('mobilenetv2'); "
+                f"got --model {args.model}"
+            )
+        if not os.path.exists(args.finetune):
+            raise SystemExit(f"--finetune: no such file {args.finetune!r}")
     initialize_backend()
     mesh = make_mesh(MeshSpec(data=-1))
     check_batch_divisibility(args.batch_size, mesh)
@@ -105,6 +128,21 @@ def main(argv=None) -> dict:
         profile_dir=args.profile_dir,
     )
     trainer = Trainer(engine, train, val, cfg, rng=jax.random.PRNGKey(0))
+    if args.finetune:
+        from distributed_model_parallel_tpu.models.torch_import import (
+            load_torch_checkpoint,
+            mobilenetv2_from_torch_state_dict,
+        )
+
+        p, s = mobilenetv2_from_torch_state_dict(
+            trainer.state.params,
+            trainer.state.model_state,
+            load_torch_checkpoint(args.finetune),
+        )
+        trainer.state = jax.device_put(
+            trainer.state._replace(params=p, model_state=s), engine._repl
+        )
+        print(f"==> Transplanted torch weights from {args.finetune}")
     return trainer.fit()
 
 

@@ -1,0 +1,168 @@
+"""Torch-checkpoint transplant for MobileNetV2 — the finetune bridge.
+
+The reference's headline accuracy table is FINETUNING from pretrained
+weights (`Readme.md:200-205`, 96.3% @ bs128), and its training scripts
+save torch `state_dict`s (`data_parallel.py:143-155`, wrapped as
+`{'net': state_dict, 'acc', 'epoch'}` with `module.*` key prefixes from
+the `nn.DataParallel` wrapper at `data_parallel.py:77`). This module maps
+that weight format into our functional pytrees, so a reference user's
+checkpoints — or any torch MobileNetV2 weights in the same layout — carry
+over: `--finetune ckpt.pth` on the CLI.
+
+Layout mapped (the reference model's `state_dict()` key schema):
+    conv1/bn1                      -> stem
+    layers.{i}.conv1/bn1/conv2/bn2/conv3/bn3 (+shortcut.0/.1)
+                                   -> blocks.{i}(.body/.shortcut)
+    conv2/bn2/linear               -> head
+
+Weight-convention transforms (pinned op-by-op against torch in
+tests/test_torch_import.py):
+    conv  OIHW -> HWIO   (transpose 2,3,1,0; depthwise O1HW -> HW1O)
+    linear (out,in) -> (in,out) (transpose)
+    BN weight/bias -> scale/bias params; running_mean/var -> state
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Tuple
+
+import numpy as np
+
+from distributed_model_parallel_tpu.models.mobilenetv2 import CFG
+
+
+def _as_numpy(value) -> np.ndarray:
+    if hasattr(value, "detach"):  # torch tensor without importing torch
+        value = value.detach().cpu().numpy()
+    return np.asarray(value)
+
+
+def normalize_state_dict(obj) -> Dict[str, np.ndarray]:
+    """Unwrap the reference's checkpoint format: accepts a bare
+    state_dict, the `{'net': state_dict, ...}` wrapper the reference
+    saves (`data_parallel.py:146-151`), and `module.*`-prefixed keys from
+    its `nn.DataParallel` wrap; values become NumPy."""
+    if isinstance(obj, dict) and "net" in obj and isinstance(obj["net"], dict):
+        obj = obj["net"]
+    out = {}
+    for k, v in obj.items():
+        if k.startswith("module."):
+            k = k[len("module."):]
+        out[k] = _as_numpy(v)
+    return out
+
+
+def _conv_w(t: np.ndarray) -> np.ndarray:
+    """torch OIHW -> our HWIO (depthwise O1HW -> HW1O is the same move)."""
+    return np.transpose(t, (2, 3, 1, 0)).astype(np.float32)
+
+
+class _Consumer:
+    """Tracks which checkpoint keys were used so the transplant can fail
+    loudly on schema drift instead of silently half-loading."""
+
+    def __init__(self, sd: Dict[str, np.ndarray]):
+        self.sd = sd
+        self.used = set()
+
+    def take(self, key: str) -> np.ndarray:
+        if key not in self.sd:
+            raise KeyError(f"checkpoint is missing expected key {key!r}")
+        self.used.add(key)
+        return self.sd[key]
+
+    def leftovers(self):
+        ignorable = {k for k in self.sd if k.endswith("num_batches_tracked")}
+        return sorted(set(self.sd) - self.used - ignorable)
+
+
+def _bn(c: _Consumer, prefix: str, params: dict, state: dict) -> None:
+    params["scale"] = c.take(f"{prefix}.weight").astype(np.float32)
+    params["bias"] = c.take(f"{prefix}.bias").astype(np.float32)
+    state["mean"] = c.take(f"{prefix}.running_mean").astype(np.float32)
+    state["var"] = c.take(f"{prefix}.running_var").astype(np.float32)
+
+
+def mobilenetv2_from_torch_state_dict(
+    params: Any,
+    state: Any,
+    state_dict: Dict[str, Any],
+    *,
+    allow_head_mismatch: bool = True,
+) -> Tuple[Any, Any]:
+    """Transplant a reference-format torch MobileNetV2 `state_dict` into
+    (params, state) from `mobilenet_v2(...).init(...)`. Returns new
+    pytrees (inputs are not mutated).
+
+    `allow_head_mismatch=True` keeps the freshly-initialized classifier
+    when the checkpoint's `linear` has a different class count — the
+    finetune-to-a-new-task path (`Readme.md:200-205` finetunes ImageNet
+    weights onto CIFAR's 10 classes)."""
+    import jax
+
+    c = _Consumer(normalize_state_dict(state_dict))
+    params = jax.tree_util.tree_map(np.asarray, params)
+    state = jax.tree_util.tree_map(np.asarray, state)
+
+    # --- stem (`conv1`/`bn1`) ----------------------------------------
+    params["stem"]["conv1"]["w"] = _conv_w(c.take("conv1.weight"))
+    _bn(c, "bn1", params["stem"]["bn1"], state["stem"]["bn1"])
+
+    # --- the 17 inverted-residual blocks ------------------------------
+    in_planes = 32
+    i = 0
+    for expansion, out_planes, num_blocks, stride in CFG:
+        for s in [stride] + [1] * (num_blocks - 1):
+            src = f"layers.{i}"
+            tgt_p = params["blocks"][str(i)]
+            tgt_s = state["blocks"][str(i)]
+            has_residual = s == 1
+            body_p = tgt_p["body"] if has_residual else tgt_p
+            body_s = tgt_s["body"] if has_residual else tgt_s
+            for conv, bn in (("conv1", "bn1"), ("conv2", "bn2"),
+                             ("conv3", "bn3")):
+                body_p[conv]["w"] = _conv_w(c.take(f"{src}.{conv}.weight"))
+                _bn(c, f"{src}.{bn}", body_p[bn], body_s[bn])
+            if has_residual and in_planes != out_planes:
+                # reference shortcut = nn.Sequential(conv, bn) -> keys .0/.1
+                tgt_p["shortcut"]["conv"]["w"] = _conv_w(
+                    c.take(f"{src}.shortcut.0.weight")
+                )
+                _bn(c, f"{src}.shortcut.1",
+                    tgt_p["shortcut"]["bn"], tgt_s["shortcut"]["bn"])
+            in_planes = out_planes
+            i += 1
+
+    # --- head (`conv2`/`bn2`/`linear`) --------------------------------
+    params["head"]["conv2"]["w"] = _conv_w(c.take("conv2.weight"))
+    _bn(c, "bn2", params["head"]["bn2"], state["head"]["bn2"])
+    lin_w = c.take("linear.weight")
+    lin_b = c.take("linear.bias")
+    if lin_w.shape[0] == params["head"]["linear"]["w"].shape[1]:
+        params["head"]["linear"]["w"] = lin_w.T.astype(np.float32)
+        params["head"]["linear"]["b"] = lin_b.astype(np.float32)
+    elif not allow_head_mismatch:
+        raise ValueError(
+            f"checkpoint head has {lin_w.shape[0]} classes, model has "
+            f"{params['head']['linear']['w'].shape[1]}"
+        )
+    # else: keep the fresh classifier (finetune-to-new-task path)
+
+    leftovers = c.leftovers()
+    if leftovers:
+        raise ValueError(
+            "checkpoint keys not consumed by the MobileNetV2 schema "
+            f"(wrong architecture?): {leftovers[:8]}"
+            + ("..." if len(leftovers) > 8 else "")
+        )
+    return params, state
+
+
+def load_torch_checkpoint(path: str) -> Dict[str, Any]:
+    """Read a torch `.pth`/`.pt` (via torch, CPU) or `.npz` checkpoint
+    into a plain dict ready for `mobilenetv2_from_torch_state_dict`."""
+    if path.endswith(".npz"):
+        return dict(np.load(path))
+    import torch
+
+    return torch.load(path, map_location="cpu", weights_only=True)

@@ -394,3 +394,52 @@ def test_stage_local_checkpoint_interop(pp_mesh, tmp_path):
         ts_l2, *local.shard_batch(images, labels), jnp.float32(0.05)
     )
     assert int(step_out.step) == int(ts_l.step) + 1
+
+
+@pytest.mark.parametrize("stage_local", [False, True])
+def test_pipeline_gradients_equal_pure_jax_grad(pp_mesh, stage_local):
+    """The check_vma=False soundness canary (VERDICT r2 item 9).
+
+    The pipeline backward relies on a hand-reasoned argument: under
+    `check_vma=False` the loss is kept LOCAL (no psum before grad) so
+    autodiff never transposes a cross-device reduction, and the reversed
+    ppermutes alone carry true cotangents upstream (`pipeline.py`
+    pipeline_forward notes). This test pins that argument numerically:
+    with momentum=0, wd=0, lr=1, one SGD step satisfies
+    grads == params_before - params_after, which must equal
+    `jax.grad` of the sequential composition on the SAME global batch.
+    If a JAX upgrade ever changes psum/ppermute transpose semantics
+    underneath shard_map, this fails loudly instead of silently
+    mis-scaling gradients.
+    """
+    stages = tiny_stages()
+    engine = PipelineEngine(
+        stages, SGD(momentum=0.0, weight_decay=0.0), pp_mesh,
+        num_microbatches=2, donate=False, stage_local_params=stage_local,
+    )
+    ts = engine.init_state(jax.random.PRNGKey(2))
+    images, labels = batch(n=16, hw=8, seed=11)
+
+    params_before = engine.params_tree(ts)
+    new_ts, _ = engine.train_step(
+        ts, *engine.shard_batch(images, labels), jnp.float32(1.0)
+    )
+    params_after = engine.params_tree(new_ts)
+    got_grads = jax.tree_util.tree_map(
+        lambda a, b: np.asarray(a) - np.asarray(b),
+        params_before, params_after,
+    )
+
+    state0 = tuple(stage.init(jax.random.PRNGKey(9))[1] for stage in stages)
+    _, _, want_grads = seq_reference(
+        stages, params_before, state0, images, labels, train=True
+    )
+    for i in range(len(stages)):
+        want_leaves = jax.tree_util.tree_leaves_with_path(want_grads[str(i)])
+        got_leaves = jax.tree_util.tree_leaves(got_grads[i])
+        assert len(want_leaves) == len(got_leaves), f"stage {i} structure"
+        for (path, w), g in zip(want_leaves, got_leaves):
+            np.testing.assert_allclose(
+                g, np.asarray(w), rtol=2e-4, atol=1e-6,
+                err_msg=f"stage {i} {jax.tree_util.keystr(path)}",
+            )
