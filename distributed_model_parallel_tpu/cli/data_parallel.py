@@ -74,6 +74,10 @@ def build_parser() -> argparse.ArgumentParser:
                         help="gspmd: compiler-partitioned (nn.DataParallel "
                              "equivalent); ddp: explicit shard_map psum "
                              "(DistributedDataParallel equivalent)")
+    parser.add_argument("--max-restarts", default=0, type=int,
+                        help="fail-fast elastic mode: restart from the "
+                             "per-epoch checkpoint up to N times on "
+                             "failure (0 = off)")
     parser.add_argument("--sync-bn", action="store_true",
                         help="SyncBatchNorm semantics under --engine ddp")
     add_common_tpu_flags(parser)
@@ -117,33 +121,69 @@ def main(argv=None) -> dict:
         )
     else:
         engine = DataParallelEngine(model, opt, mesh, compute_dtype=cdt)
-    cfg = TrainerConfig(
-        epochs=args.epochs,
-        base_lr=args.lr,
-        t_max=90,
-        warmup_period=10,
-        log_file=args.log_file or f"data_para_{args.batch_size}.txt",
-        resume=args.resume,
-        steps_per_epoch=args.steps_per_epoch,
-        profile_dir=args.profile_dir,
-    )
-    trainer = Trainer(engine, train, val, cfg, rng=jax.random.PRNGKey(0))
-    if args.finetune:
-        from distributed_model_parallel_tpu.models.torch_import import (
-            load_torch_checkpoint,
-            mobilenetv2_from_torch_state_dict,
+    checkpoint_dir = "./checkpoint"  # single source of truth (cfg + probes)
+
+    def _restart_can_resume() -> bool:
+        """Host-0-authoritative: checkpoints are written by host 0 only,
+        so on per-host disks every process must adopt host 0's answer or
+        the hosts disagree on resume and deadlock in the restore
+        broadcast."""
+        from distributed_model_parallel_tpu.training.checkpoint import (
+            latest_exists,
         )
 
-        p, s = mobilenetv2_from_torch_state_dict(
-            trainer.state.params,
-            trainer.state.model_state,
-            load_torch_checkpoint(args.finetune),
+        exists = latest_exists(checkpoint_dir, "last") or latest_exists(
+            checkpoint_dir
         )
-        trainer.state = jax.device_put(
-            trainer.state._replace(params=p, model_state=s), engine._repl
+        if jax.process_count() > 1:
+            import numpy as np
+            from jax.experimental import multihost_utils
+
+            exists = bool(int(
+                multihost_utils.broadcast_one_to_all(np.int32(exists))
+            ))
+        return exists
+
+    def make_trainer(restart: bool) -> Trainer:
+        resume = args.resume or (restart and _restart_can_resume())
+        cfg = TrainerConfig(
+            epochs=args.epochs,
+            base_lr=args.lr,
+            t_max=90,
+            warmup_period=10,
+            log_file=args.log_file or f"data_para_{args.batch_size}.txt",
+            checkpoint_dir=checkpoint_dir,
+            resume=resume,
+            steps_per_epoch=args.steps_per_epoch,
+            profile_dir=args.profile_dir,
+            save_last=args.max_restarts > 0,
         )
-        print(f"==> Transplanted torch weights from {args.finetune}")
-    return trainer.fit()
+        trainer = Trainer(engine, train, val, cfg, rng=jax.random.PRNGKey(0))
+        if args.finetune and not resume:
+            from distributed_model_parallel_tpu.models.torch_import import (
+                load_torch_checkpoint,
+                mobilenetv2_from_torch_state_dict,
+            )
+
+            p, s = mobilenetv2_from_torch_state_dict(
+                trainer.state.params,
+                trainer.state.model_state,
+                load_torch_checkpoint(args.finetune),
+            )
+            trainer.state = jax.device_put(
+                trainer.state._replace(params=p, model_state=s),
+                engine._repl,
+            )
+            print(f"==> Transplanted torch weights from {args.finetune}")
+        return trainer
+
+    if args.max_restarts > 0:
+        from distributed_model_parallel_tpu.training.elastic import (
+            elastic_fit,
+        )
+
+        return elastic_fit(make_trainer, max_restarts=args.max_restarts)
+    return make_trainer(False).fit()
 
 
 if __name__ == "__main__":

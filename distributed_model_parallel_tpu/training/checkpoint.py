@@ -182,3 +182,19 @@ def restore_checkpoint(
 
 def latest_exists(directory: str, name: str = "ckpt") -> bool:
     return os.path.isfile(os.path.join(directory, f"{name}.npz"))
+
+
+def checkpoint_epoch(directory: str, name: str = "ckpt") -> Optional[int]:
+    """Epoch recorded in `{name}.json`, or None when the checkpoint (or
+    its sidecar) is absent/corrupt — used to pick the NEWER of the
+    best-acc and per-epoch snapshots on resume, rather than trusting
+    file existence (a stale 'last' from an older run must not roll a
+    newer 'ckpt' back)."""
+    meta_path = os.path.join(directory, f"{name}.json")
+    if not latest_exists(directory, name) or not os.path.isfile(meta_path):
+        return None
+    try:
+        with open(meta_path) as f:
+            return int(json.load(f).get("epoch", 0))
+    except (OSError, ValueError, json.JSONDecodeError):
+        return None

@@ -1,0 +1,57 @@
+"""Fail-fast + restart-from-checkpoint driver loop.
+
+The failure story SURVEY.md §5 plans (and the reference entirely lacks —
+a crashed rank hangs its blocking `dist.send/recv` pipeline forever,
+`distributed_layers.py:11-13,52`): training runs under a supervisor that
+catches a failed attempt, rebuilds the trainer, resumes from the newest
+checkpoint (`TrainerConfig.save_last` writes one per epoch), and retries
+up to `max_restarts` times. Failures that exhaust the budget re-raise —
+fail-fast, never hang.
+
+On multi-host TPU deployments the inter-host failure *detection* is
+`jax.distributed`'s own runtime (a lost host fails the collective with a
+distributed-runtime error, which lands here as the caught exception);
+this loop supplies the restart-from-checkpoint policy on top.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Callable, Optional, Sequence
+
+
+def elastic_fit(
+    make_trainer: Callable[[bool], Any],
+    *,
+    max_restarts: int = 2,
+    backoff_seconds: float = 1.0,
+    retry_on: Sequence[type] = (Exception,),
+    on_restart: Optional[Callable[[int, BaseException], None]] = None,
+) -> dict:
+    """Run `make_trainer(resume).fit()` with restart-on-failure.
+
+    `make_trainer(resume: bool)` must build a FRESH trainer; it receives
+    resume=False on the first attempt and resume=True afterwards (its
+    TrainerConfig should set `resume=resume and a checkpoint exists`, and
+    `save_last=True` so restarts lose at most one epoch).
+    KeyboardInterrupt always propagates immediately.
+    """
+    attempt = 0
+    while True:
+        trainer = make_trainer(attempt > 0)
+        try:
+            return trainer.fit()
+        except KeyboardInterrupt:
+            raise
+        except tuple(retry_on) as e:  # noqa: BLE001 — policy boundary
+            attempt += 1
+            if attempt > max_restarts:
+                raise
+            if on_restart is not None:
+                on_restart(attempt, e)
+            print(
+                f"==> attempt {attempt}/{max_restarts} failed with "
+                f"{type(e).__name__}: {e}; restarting from checkpoint",
+                flush=True,
+            )
+            time.sleep(backoff_seconds * attempt)

@@ -33,6 +33,7 @@ import jax.numpy as jnp
 
 from distributed_model_parallel_tpu.runtime.dist import is_primary
 from distributed_model_parallel_tpu.training.checkpoint import (
+    checkpoint_epoch,
     restore_checkpoint,
     save_checkpoint,
 )
@@ -81,6 +82,12 @@ class TrainerConfig:
     # The trace is the tool for attributing a bad MFU number (SURVEY.md §5
     # tracing row) — open with TensorBoard or xprof.
     profile_dir: Optional[str] = None
+    # Also write a 'last' checkpoint at the END of every epoch (not just
+    # on best val acc). This is what makes a run restartable after a
+    # failure — the elastic driver loop (`training/elastic.py`) resumes
+    # from it; `--resume` prefers it over the best-acc snapshot when it
+    # is newer.
+    save_last: bool = False
 
 
 class Trainer:
@@ -109,8 +116,20 @@ class Trainer:
         self.best_acc = 0.0
         self.start_epoch = 0
         if config.resume:
+            # Resume from whichever snapshot is NEWER by its recorded
+            # epoch: the per-epoch 'last' (written under save_last) when
+            # it is ahead of the best-acc 'ckpt', so an elastic restart
+            # loses at most the failed epoch — but a stale 'last' from an
+            # older run never rolls a newer 'ckpt' back. Only host 0's
+            # files matter: restore_checkpoint broadcasts host-0's read.
+            name = "ckpt"
+            last_ep = checkpoint_epoch(config.checkpoint_dir, "last")
+            ckpt_ep = checkpoint_epoch(config.checkpoint_dir, "ckpt")
+            if last_ep is not None and (ckpt_ep is None or last_ep >= ckpt_ep):
+                name = "last"
             restored, self.best_acc, last_epoch = restore_checkpoint(
-                config.checkpoint_dir, self._to_canonical(self.state)
+                config.checkpoint_dir, self._to_canonical(self.state),
+                name=name,
             )
             self.state = self._from_canonical(restored)
             self.start_epoch = last_epoch + 1
@@ -238,18 +257,33 @@ class Trainer:
                 if self.val_loader is not None
                 else EpochStats()
             )
-            if (
+            is_best = (
                 cfg.save_best
                 and self.val_loader is not None
                 and val_stats.acc1 > self.best_acc
-            ):
+            )
+            if is_best or cfg.save_last:
+                canonical = self._to_canonical(self.state)  # once per epoch
+            if is_best:
                 self.best_acc = val_stats.acc1
                 self._log_print("Saving..")
                 save_checkpoint(
                     cfg.checkpoint_dir,
-                    self._to_canonical(self.state),
+                    canonical,
                     acc=self.best_acc,
                     epoch=epoch,
+                )
+            if cfg.save_last:
+                # acc records the best-so-far (restored into best_acc on
+                # resume) — storing this epoch's val acc here would let a
+                # restart reset best_acc downward and a worse model later
+                # overwrite the best snapshot.
+                save_checkpoint(
+                    cfg.checkpoint_dir,
+                    canonical,
+                    acc=self.best_acc,
+                    epoch=epoch,
+                    name="last",
                 )
             self._append_epoch_log(epoch, train_stats, val_stats)
         return {

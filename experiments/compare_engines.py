@@ -1,0 +1,198 @@
+"""The reference's comparative experiment, reproduced on this framework.
+
+`/root/reference/Readme.md:283-294` trains the same workload under
+data parallelism and model (pipeline) parallelism and publishes val-acc +
+time/batch for both (plus a loss/acc overlay figure,
+`pic/image-20220123205017868.png`). This script is that experiment for
+the TPU-native engines: DP (GSPMD) vs DDP (explicit collectives) vs
+pipeline MP (M=1, the reference's schedule; M=8, GPipe), same model,
+same data, same schedule — emitting a markdown table, training-curve
+figures under pic/, and a `published` block for BASELINE.json.
+
+Run (CPU topology-mesh, the hermetic default):
+    python experiments/compare_engines.py --out results.json
+
+Run on an accelerator (same experiment, flagship model):
+    python experiments/compare_engines.py --platform default \
+        --model mobilenetv2 --batch 512 --dataset CIFAR10
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--platform", default="cpu", choices=("cpu", "default"))
+    ap.add_argument("--model", default="tinycnn")
+    ap.add_argument("--dataset", default="Synthetic")
+    ap.add_argument("--data", default="./data")
+    ap.add_argument("--batch", type=int, default=256)
+    ap.add_argument("--val-batch", type=int, default=None)
+    ap.add_argument("--epochs", type=int, default=6)
+    ap.add_argument("--steps-per-epoch", type=int, default=0)
+    ap.add_argument("--lr", type=float, default=0.1)
+    ap.add_argument("--stages", type=int, default=4)
+    ap.add_argument("--engines", default=None,
+                    help="comma-separated subset filter, e.g. pp_m1,pp_m8")
+    ap.add_argument("--dtype", default="float32",
+                    choices=("float32", "bfloat16"))
+    ap.add_argument("--out", default="experiments/results.json")
+    ap.add_argument("--pic-dir", default="pic")
+    args = ap.parse_args()
+
+    if args.platform == "cpu":
+        from distributed_model_parallel_tpu.runtime.platform import force_cpu
+
+        force_cpu(8)
+
+    import jax
+
+    from distributed_model_parallel_tpu.cli.common import (
+        STAGE_BUILDERS,
+        build_loaders,
+        build_model,
+        compute_dtype_from_flag,
+    )
+    from distributed_model_parallel_tpu.parallel import (
+        DataParallelEngine,
+        DDPEngine,
+        PipelineEngine,
+    )
+    from distributed_model_parallel_tpu.runtime.mesh import MeshSpec, make_mesh
+    from distributed_model_parallel_tpu.training.optim import SGD
+    from distributed_model_parallel_tpu.training.trainer import (
+        Trainer,
+        TrainerConfig,
+    )
+
+    n_dev = len(jax.devices())
+    stages_n = args.stages if n_dev % args.stages == 0 else 1
+    cdt = compute_dtype_from_flag(args.dtype)
+    train, val, num_classes = build_loaders(
+        args.dataset, args.data, args.batch,
+        val_batch_size=args.val_batch,
+    )
+    opt = SGD()
+    wanted = set(args.engines.split(",")) if args.engines else None
+
+    def engines():
+        dp_mesh = make_mesh(MeshSpec(data=-1))
+        yield "dp_gspmd", DataParallelEngine(
+            build_model(args.model, num_classes), opt, dp_mesh,
+            compute_dtype=cdt,
+        )
+        yield "ddp", DDPEngine(
+            build_model(args.model, num_classes), opt, dp_mesh,
+            compute_dtype=cdt,
+        )
+        if stages_n > 1 or n_dev == 1:
+            pp_mesh = make_mesh(MeshSpec(data=-1, stage=max(stages_n, 1)))
+            stages = STAGE_BUILDERS[args.model](
+                max(stages_n, 1), num_classes, None
+            )
+            for m in (1, 8):
+                yield f"pp_m{m}", PipelineEngine(
+                    stages, opt, pp_mesh, num_microbatches=m,
+                    compute_dtype=cdt,
+                )
+
+    # Resume-friendly: prior results (e.g. a fast-engine run) merge in, so
+    # the slow pipeline engines can run in a separate invocation.
+    results = {}
+    if os.path.exists(args.out):
+        with open(args.out) as f:
+            results = json.load(f).get("results", {})
+    for name, engine in engines():
+        if wanted is not None and name not in wanted:
+            continue
+        print(f"=== {name} ===", flush=True)
+        cfg = TrainerConfig(
+            epochs=args.epochs, base_lr=args.lr, t_max=max(args.epochs, 2),
+            warmup_period=2, print_freq=0,
+            log_dir="./log", log_file=f"compare_{name}.txt",
+            checkpoint_dir=f"./checkpoint/compare_{name}", save_best=False,
+            steps_per_epoch=args.steps_per_epoch,
+        )
+        t0 = time.perf_counter()
+        trainer = Trainer(engine, train, val, cfg, rng=jax.random.PRNGKey(0))
+        out = trainer.fit()
+        wall = time.perf_counter() - t0
+        hist = out["history"]
+        # Steady-state time/batch: skip epoch 0 (compile).
+        steady = hist[1:] or hist
+        results[name] = {
+            "val_acc1": hist[-1]["val"]["acc1"],
+            "train_acc1": hist[-1]["train"]["acc1"],
+            "time_per_batch": sum(
+                h["train"]["batch_time"] for h in steady
+            ) / len(steady),
+            "data_time_per_batch": sum(
+                h["train"]["data_time"] for h in steady
+            ) / len(steady),
+            "wall_seconds": wall,
+            "history": hist,
+        }
+        print(json.dumps({k: v for k, v in results[name].items()
+                          if k != "history"}), flush=True)
+        meta = {
+            "platform": jax.devices()[0].platform,
+            "device_kind": jax.devices()[0].device_kind,
+            "n_devices": n_dev,
+            "model": args.model,
+            "dataset": args.dataset,
+            "global_batch": args.batch,
+            "epochs": args.epochs,
+            "lr": args.lr,
+            "dtype": args.dtype,
+            "pipeline_stages": stages_n,
+        }
+        os.makedirs(os.path.dirname(args.out) or ".", exist_ok=True)
+        with open(args.out, "w") as f:  # incremental: survive timeouts
+            json.dump({"meta": meta, "results": results}, f, indent=2)
+
+    # ---- figures (the reference's loss/acc overlay, pic/*.png) --------
+    os.makedirs(args.pic_dir, exist_ok=True)
+    import matplotlib
+
+    matplotlib.use("Agg")
+    import matplotlib.pyplot as plt
+
+    fig, axes = plt.subplots(1, 2, figsize=(11, 4))
+    for name, r in results.items():
+        epochs = [h["epoch"] for h in r["history"]]
+        axes[0].plot(epochs, [h["train"]["loss"] for h in r["history"]],
+                     label=name)
+        axes[1].plot(epochs, [h["val"]["acc1"] for h in r["history"]],
+                     label=name)
+    axes[0].set_xlabel("epoch"); axes[0].set_ylabel("train loss")
+    axes[1].set_xlabel("epoch"); axes[1].set_ylabel("val acc@1 (%)")
+    for ax in axes:
+        ax.legend(); ax.grid(alpha=0.3)
+    fig.suptitle(
+        f"DP vs DDP vs pipeline — {meta['model']} {meta['dataset']} "
+        f"bs{meta['global_batch']} on {n_dev}x {meta['platform']}"
+    )
+    fig.tight_layout()
+    curve_path = os.path.join(args.pic_dir, "compare_engines.png")
+    fig.savefig(curve_path, dpi=120)
+    print(f"wrote {args.out} and {curve_path}")
+
+    # ---- markdown table ----------------------------------------------
+    print("\n| engine | val acc@1 | time/batch (s) | data time (s) |")
+    print("|---|---|---|---|")
+    for name, r in results.items():
+        print(f"| {name} | {r['val_acc1']:.2f}% | "
+              f"{r['time_per_batch']:.4f} | "
+              f"{r['data_time_per_batch']:.4f} |")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,104 @@
+"""Elastic restart tests: fail-fast + resume-from-checkpoint loop
+(SURVEY.md §5 failure-detection row; VERDICT r2 'what's weak' #8)."""
+
+import jax
+import pytest
+
+from distributed_model_parallel_tpu.data.datasets import synthetic
+from distributed_model_parallel_tpu.data.loader import Loader
+from distributed_model_parallel_tpu.models.tinycnn import tiny_cnn
+from distributed_model_parallel_tpu.parallel.data_parallel import (
+    DataParallelEngine,
+)
+from distributed_model_parallel_tpu.runtime.mesh import MeshSpec, make_mesh
+from distributed_model_parallel_tpu.training.checkpoint import latest_exists
+from distributed_model_parallel_tpu.training.elastic import elastic_fit
+from distributed_model_parallel_tpu.training.optim import SGD
+from distributed_model_parallel_tpu.training.trainer import (
+    Trainer,
+    TrainerConfig,
+)
+
+
+class FlakyEngine:
+    """Engine wrapper that dies once at a chosen train step — the
+    single-controller stand-in for a lost host (whose collective error
+    surfaces exactly like this: an exception out of train_step)."""
+
+    def __init__(self, inner, fail_at_call: int):
+        self.inner = inner
+        self.fail_at_call = fail_at_call
+        self.calls = 0
+        self.already_failed = False
+
+    def __getattr__(self, name):
+        return getattr(self.inner, name)
+
+    def train_step(self, *args):
+        self.calls += 1
+        if not self.already_failed and self.calls == self.fail_at_call:
+            self.already_failed = True
+            raise RuntimeError("injected host failure")
+        return self.inner.train_step(*args)
+
+
+def _factory(tmp_path, engine, epochs=4):
+    ds = synthetic(num_examples=128, num_classes=4, image_size=8, seed=0)
+    trainers = []
+
+    def make_trainer(restart: bool) -> Trainer:
+        cfg = TrainerConfig(
+            epochs=epochs, base_lr=0.05, t_max=epochs, warmup_period=1,
+            print_freq=0,
+            log_dir=str(tmp_path / "log"),
+            checkpoint_dir=str(tmp_path / "ckpt"),
+            resume=restart and latest_exists(str(tmp_path / "ckpt"), "last"),
+            save_last=True,
+        )
+        train = Loader(ds, batch_size=32, shuffle=True, seed=0)
+        val = Loader(ds, batch_size=32, shuffle=False)
+        t = Trainer(engine, train, val, cfg, rng=jax.random.PRNGKey(0))
+        trainers.append(t)
+        return t
+
+    return make_trainer, trainers
+
+
+def test_elastic_restarts_from_last_checkpoint(tmp_path):
+    mesh = make_mesh(MeshSpec(data=8))
+    engine = FlakyEngine(
+        DataParallelEngine(tiny_cnn(4), SGD(), mesh, donate=False),
+        fail_at_call=7,  # dies in epoch 1 (4 steps/epoch)
+    )
+    make_trainer, trainers = _factory(tmp_path, engine)
+    result = elastic_fit(make_trainer, max_restarts=2)
+
+    assert len(trainers) == 2                # one restart
+    assert trainers[0].start_epoch == 0
+    # Epoch 0 completed + save_last ran before the injected failure, so
+    # the restart resumes at epoch 1 — at most the failed epoch is lost.
+    assert trainers[1].start_epoch == 1
+    total_epochs = {h["epoch"] for h in result["history"]}
+    assert total_epochs == {1, 2, 3}         # final attempt's epochs
+    assert latest_exists(str(tmp_path / "ckpt"), "last")
+
+
+def test_elastic_gives_up_after_budget(tmp_path):
+    class AlwaysDies:
+        def __init__(self, inner):
+            self.inner = inner
+
+        def __getattr__(self, name):
+            return getattr(self.inner, name)
+
+        def train_step(self, *args):
+            raise RuntimeError("permanent failure")
+
+    mesh = make_mesh(MeshSpec(data=8))
+    engine = AlwaysDies(
+        DataParallelEngine(tiny_cnn(4), SGD(), mesh, donate=False)
+    )
+    make_trainer, trainers = _factory(tmp_path, engine)
+    with pytest.raises(RuntimeError, match="permanent failure"):
+        elastic_fit(make_trainer, max_restarts=2, backoff_seconds=0.01)
+    assert len(trainers) == 3  # initial + 2 restarts, then fail-fast
