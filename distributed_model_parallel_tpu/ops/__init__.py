@@ -1,10 +1,14 @@
 """Compute ops: attention cores (reference-free — the reference has no
-attention model; BERT-base is demanded by BASELINE.json's configs) and
-their sequence-parallel variants (ring attention over ppermute, Ulysses
-all-to-all)."""
+attention model; BERT-base is demanded by BASELINE.json's configs), their
+sequence-parallel variants (ring attention over ppermute, Ulysses
+all-to-all), and the Pallas flash-attention forward kernel for the
+single-chip hot path."""
 
 from distributed_model_parallel_tpu.ops.attention import (  # noqa: F401
     dot_product_attention,
+)
+from distributed_model_parallel_tpu.ops.pallas_attention import (  # noqa: F401
+    flash_attention,
 )
 from distributed_model_parallel_tpu.ops.ring_attention import (  # noqa: F401
     ring_attention,

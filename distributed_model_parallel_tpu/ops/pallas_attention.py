@@ -1,0 +1,191 @@
+"""Fused flash-attention forward kernel in Pallas (TPU).
+
+The attention hot op, tiled for the MXU with online softmax so the
+(Tq, Tkv) logits matrix never materializes in HBM: the grid streams
+(block_q x block_k) tiles, q@k^T runs on the MXU in f32, and the running
+max / denominator / numerator live in VMEM scratch across the k-block
+grid steps (TPU grids iterate the last axis innermost, and scratch
+persists across steps — the canonical Pallas flash pattern).
+
+Scope and honesty notes:
+* Forward only. `flash_attention` carries a custom_vjp whose backward
+  RECOMPUTES attention through the plain XLA path (`ops/attention.py`)
+  — gradients are exact, but the backward pass materializes logits like
+  the reference path does; a fused flash backward kernel is future work.
+* Same contract as `dot_product_attention`: (B, T, H, Dh) tensors,
+  optional (B, Tkv) key-validity mask, computes f32, returns q.dtype.
+* Sequence lengths must divide the block sizes (the wrapper shrinks
+  blocks to fit when the sequence is shorter); composes with ring /
+  Ulysses sequence parallelism, which shard T across chips before any
+  kernel runs.
+* On non-TPU backends the kernel runs in Pallas interpret mode (slow,
+  CI-only) so the numerics are testable on the 8-virtual-device mesh.
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+try:  # pltpu is importable on CPU builds too; guard for safety
+    from jax.experimental.pallas import tpu as pltpu
+
+    _VMEM = pltpu.VMEM
+except Exception:  # pragma: no cover - exotic builds
+    pltpu = None
+    _VMEM = None
+
+from distributed_model_parallel_tpu.ops.attention import (
+    dot_product_attention,
+)
+
+_NEG = jnp.finfo(jnp.float32).min
+
+
+def _flash_kernel(q_ref, k_ref, v_ref, mask_ref, o_ref,
+                  m_scr, l_scr, acc_scr, *, scale: float, nk: int):
+    ki = pl.program_id(3)
+
+    @pl.when(ki == 0)
+    def _():
+        m_scr[:] = jnp.full_like(m_scr[:], _NEG)
+        l_scr[:] = jnp.zeros_like(l_scr[:])
+        acc_scr[:] = jnp.zeros_like(acc_scr[:])
+
+    q = q_ref[0, 0].astype(jnp.float32) * scale          # (bq, dh)
+    k = k_ref[0, 0].astype(jnp.float32)                  # (bk, dh)
+    v = v_ref[0, 0].astype(jnp.float32)                  # (bk, dh)
+    valid = mask_ref[0] != 0                             # (bk,)
+
+    s = jax.lax.dot_general(                             # (bq, bk) on MXU
+        q, k,
+        dimension_numbers=(((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+    s = jnp.where(valid[None, :], s, _NEG)
+
+    m_prev = m_scr[:, 0]                                 # (bq,)
+    m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1))
+    # exp(_NEG - m_new) underflows to 0 for any finite m_new; an
+    # all-masked prefix keeps l == 0 and is guarded at finalize.
+    p = jnp.exp(s - m_new[:, None])                      # (bq, bk)
+    corr = jnp.exp(m_prev - m_new)                       # (bq,)
+    l_scr[:, 0] = l_scr[:, 0] * corr + jnp.sum(p, axis=-1)
+    acc_scr[:] = acc_scr[:] * corr[:, None] + jax.lax.dot_general(
+        p, v,
+        dimension_numbers=(((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+    m_scr[:, 0] = m_new
+
+    @pl.when(ki == nk - 1)
+    def _():
+        l = l_scr[:, 0]
+        denom = jnp.where(l > 0, l, 1.0)
+        o_ref[0, 0] = (acc_scr[:] / denom[:, None]).astype(o_ref.dtype)
+
+
+def _pick_block(t: int, want: int) -> int:
+    """Largest divisor of `t` that is <= want (block shapes must tile the
+    sequence exactly)."""
+    b = min(want, t)
+    while t % b:
+        b -= 1
+    return b
+
+
+def _flash_forward(q, k, v, mask, scale, block_q, block_k, interpret):
+    b, tq, h, dh = q.shape
+    tk = k.shape[1]
+    bq = _pick_block(tq, block_q)
+    bk = _pick_block(tk, block_k)
+    nq, nk = tq // bq, tk // bk
+
+    # (B, H, T, Dh) layout for clean (seq, head_dim) blocks.
+    qt = jnp.transpose(q, (0, 2, 1, 3))
+    kt = jnp.transpose(k, (0, 2, 1, 3))
+    vt = jnp.transpose(v, (0, 2, 1, 3))
+    mask8 = (
+        mask.astype(jnp.int8) if mask is not None
+        else jnp.ones((b, tk), jnp.int8)
+    )
+
+    kernel = functools.partial(_flash_kernel, scale=scale, nk=nk)
+    out = pl.pallas_call(
+        kernel,
+        grid=(b, h, nq, nk),
+        in_specs=[
+            pl.BlockSpec((1, 1, bq, dh), lambda bi, hi, qi, ki: (bi, hi, qi, 0)),
+            pl.BlockSpec((1, 1, bk, dh), lambda bi, hi, qi, ki: (bi, hi, ki, 0)),
+            pl.BlockSpec((1, 1, bk, dh), lambda bi, hi, qi, ki: (bi, hi, ki, 0)),
+            pl.BlockSpec((1, bk), lambda bi, hi, qi, ki: (bi, ki)),
+        ],
+        out_specs=pl.BlockSpec(
+            (1, 1, bq, dh), lambda bi, hi, qi, ki: (bi, hi, qi, 0)
+        ),
+        out_shape=jax.ShapeDtypeStruct((b, h, tq, dh), q.dtype),
+        scratch_shapes=[
+            _VMEM((bq, 1), jnp.float32),   # running max
+            _VMEM((bq, 1), jnp.float32),   # running denominator
+            _VMEM((bq, dh), jnp.float32),  # running numerator
+        ],
+        interpret=interpret,
+    )(qt, kt, vt, mask8)
+    return jnp.transpose(out, (0, 2, 1, 3))
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7))
+def _flash(q, k, v, mask, scale, block_q, block_k, interpret):
+    return _flash_forward(q, k, v, mask, scale, block_q, block_k, interpret)
+
+
+def _flash_fwd(q, k, v, mask, scale, block_q, block_k, interpret):
+    out = _flash_forward(q, k, v, mask, scale, block_q, block_k, interpret)
+    return out, (q, k, v, mask)
+
+
+def _flash_bwd(scale, block_q, block_k, interpret, res, g):
+    # Exact gradients by recomputing attention through the XLA reference
+    # path (see module docstring).
+    q, k, v, mask = res
+    _, vjp = jax.vjp(
+        lambda q, k, v: dot_product_attention(q, k, v, mask, scale=scale),
+        q, k, v,
+    )
+    dq, dk, dv = vjp(g)
+    return dq, dk, dv, None
+
+
+_flash.defvjp(_flash_fwd, _flash_bwd)
+
+
+def flash_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    mask: Optional[jax.Array] = None,
+    *,
+    scale: Optional[float] = None,
+    block_q: int = 128,
+    block_k: int = 128,
+    interpret: Optional[bool] = None,
+) -> jax.Array:
+    """Drop-in `attention_fn` backed by the Pallas flash forward kernel.
+
+    `interpret=None` auto-selects: compiled on TPU, interpreter
+    elsewhere (tests). See module docstring for scope.
+    """
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    scale = scale if scale is not None else 1.0 / math.sqrt(q.shape[-1])
+    if mask is not None and mask.ndim != 2:
+        raise NotImplementedError(
+            "flash_attention supports (B, Tkv) key-validity masks; use "
+            "dot_product_attention for general logit masks"
+        )
+    return _flash(q, k, v, mask, scale, block_q, block_k, interpret)
