@@ -47,8 +47,8 @@ from distributed_model_parallel_tpu.ops.attention import (
 _NEG = jnp.finfo(jnp.float32).min
 
 
-def _flash_kernel(q_ref, k_ref, v_ref, mask_ref, o_ref,
-                  m_scr, l_scr, acc_scr, *, scale: float, nk: int):
+def _flash_step(q_ref, k_ref, v_ref, valid, o_ref,
+                m_scr, l_scr, acc_scr, scale: float, nk: int):
     ki = pl.program_id(3)
 
     @pl.when(ki == 0)
@@ -60,14 +60,14 @@ def _flash_kernel(q_ref, k_ref, v_ref, mask_ref, o_ref,
     q = q_ref[0, 0].astype(jnp.float32) * scale          # (bq, dh)
     k = k_ref[0, 0].astype(jnp.float32)                  # (bk, dh)
     v = v_ref[0, 0].astype(jnp.float32)                  # (bk, dh)
-    valid = mask_ref[0] != 0                             # (bk,)
 
     s = jax.lax.dot_general(                             # (bq, bk) on MXU
         q, k,
         dimension_numbers=(((1,), (1,)), ((), ())),
         preferred_element_type=jnp.float32,
     )
-    s = jnp.where(valid[None, :], s, _NEG)
+    if valid is not None:  # static: masked kernel variant only
+        s = jnp.where(valid[None, :], s, _NEG)
 
     m_prev = m_scr[:, 0]                                 # (bq,)
     m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1))
@@ -90,6 +90,20 @@ def _flash_kernel(q_ref, k_ref, v_ref, mask_ref, o_ref,
         o_ref[0, 0] = (acc_scr[:] / denom[:, None]).astype(o_ref.dtype)
 
 
+def _flash_kernel(q_ref, k_ref, v_ref, mask_ref, o_ref,
+                  m_scr, l_scr, acc_scr, *, scale: float, nk: int):
+    _flash_step(q_ref, k_ref, v_ref, mask_ref[0] != 0, o_ref,
+                m_scr, l_scr, acc_scr, scale, nk)
+
+
+def _flash_kernel_nomask(q_ref, k_ref, v_ref, o_ref,
+                         m_scr, l_scr, acc_scr, *, scale: float, nk: int):
+    # mask=None specialization: no dummy mask streamed per grid step, no
+    # per-tile where on the hot path.
+    _flash_step(q_ref, k_ref, v_ref, None, o_ref,
+                m_scr, l_scr, acc_scr, scale, nk)
+
+
 def _pick_block(t: int, want: int) -> int:
     """Largest divisor of `t` that is <= want (block shapes must tile the
     sequence exactly)."""
@@ -104,27 +118,34 @@ def _flash_forward(q, k, v, mask, scale, block_q, block_k, interpret):
     tk = k.shape[1]
     bq = _pick_block(tq, block_q)
     bk = _pick_block(tk, block_k)
+    if bq < 8 or bk < 8:
+        # Awkward sequence lengths (prime/odd) would force sub-sublane
+        # blocks — a silent performance cliff and a Mosaic tiling risk.
+        # The XLA path is the better program there.
+        return dot_product_attention(q, k, v, mask, scale=scale)
     nq, nk = tq // bq, tk // bk
 
     # (B, H, T, Dh) layout for clean (seq, head_dim) blocks.
     qt = jnp.transpose(q, (0, 2, 1, 3))
     kt = jnp.transpose(k, (0, 2, 1, 3))
     vt = jnp.transpose(v, (0, 2, 1, 3))
-    mask8 = (
-        mask.astype(jnp.int8) if mask is not None
-        else jnp.ones((b, tk), jnp.int8)
-    )
 
-    kernel = functools.partial(_flash_kernel, scale=scale, nk=nk)
+    qspec = pl.BlockSpec((1, 1, bq, dh), lambda bi, hi, qi, ki: (bi, hi, qi, 0))
+    kspec = pl.BlockSpec((1, 1, bk, dh), lambda bi, hi, qi, ki: (bi, hi, ki, 0))
+    operands = [qt, kt, vt]
+    in_specs = [qspec, kspec, kspec]
+    if mask is not None:
+        kernel = functools.partial(_flash_kernel, scale=scale, nk=nk)
+        operands.append(mask.astype(jnp.int8))
+        in_specs.append(
+            pl.BlockSpec((1, bk), lambda bi, hi, qi, ki: (bi, ki))
+        )
+    else:
+        kernel = functools.partial(_flash_kernel_nomask, scale=scale, nk=nk)
     out = pl.pallas_call(
         kernel,
         grid=(b, h, nq, nk),
-        in_specs=[
-            pl.BlockSpec((1, 1, bq, dh), lambda bi, hi, qi, ki: (bi, hi, qi, 0)),
-            pl.BlockSpec((1, 1, bk, dh), lambda bi, hi, qi, ki: (bi, hi, ki, 0)),
-            pl.BlockSpec((1, 1, bk, dh), lambda bi, hi, qi, ki: (bi, hi, ki, 0)),
-            pl.BlockSpec((1, bk), lambda bi, hi, qi, ki: (bi, ki)),
-        ],
+        in_specs=in_specs,
         out_specs=pl.BlockSpec(
             (1, 1, bq, dh), lambda bi, hi, qi, ki: (bi, hi, qi, 0)
         ),
@@ -135,7 +156,7 @@ def _flash_forward(q, k, v, mask, scale, block_q, block_k, interpret):
             _VMEM((bq, dh), jnp.float32),  # running numerator
         ],
         interpret=interpret,
-    )(qt, kt, vt, mask8)
+    )(*operands)
     return jnp.transpose(out, (0, 2, 1, 3))
 
 
@@ -180,6 +201,12 @@ def flash_attention(
     `interpret=None` auto-selects: compiled on TPU, interpreter
     elsewhere (tests). See module docstring for scope.
     """
+    if _VMEM is None:
+        raise RuntimeError(
+            "flash_attention needs jax.experimental.pallas.tpu, which "
+            "failed to import in this environment; use "
+            "ops.attention.dot_product_attention instead"
+        )
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
     scale = scale if scale is not None else 1.0 / math.sqrt(q.shape[-1])

@@ -109,3 +109,14 @@ def test_general_mask_rejected():
     full_mask = jnp.ones((B, 1, 64, 64), bool)
     with pytest.raises(NotImplementedError):
         flash_attention(q, k, v, full_mask)
+
+
+def test_prime_length_falls_back_to_xla_path():
+    """Sequence lengths whose divisors are all < 8 (e.g. primes) take the
+    XLA reference path instead of a sub-sublane-block kernel."""
+    q, k, v, _ = _qkv(seed=8, t=17)
+    want = dot_product_attention(q, k, v)
+    got = flash_attention(q, k, v)
+    np.testing.assert_allclose(
+        np.asarray(got), np.asarray(want), rtol=1e-5, atol=1e-5
+    )
