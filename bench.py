@@ -371,22 +371,35 @@ def main() -> None:
     def remaining() -> float:
         return deadline - time.monotonic()
 
-    # --- one patient accelerator child: dial + measure in one process ----
-    accel_timeout = remaining() - CPU_FALLBACK_RESERVE_S
+    # --- patient accelerator child: dial + measure in one process. A
+    # child that CRASHES fast (transient tunnel error, not a hang) gets
+    # one retry while the budget allows; a timed-out child consumed its
+    # whole patience, so no retry is possible.
     accel_err = ""
-    if accel_timeout > 60:
-        log(f"accelerator child gets {accel_timeout:.0f}s")
+    attempts = 0
+    while True:
+        accel_timeout = remaining() - CPU_FALLBACK_RESERVE_S
+        if accel_timeout <= 60:
+            accel_err = accel_err or "no budget left for accelerator child"
+            break
+        attempts += 1
+        log(f"accelerator child (attempt {attempts}) gets "
+            f"{accel_timeout:.0f}s")
+        t_child = time.monotonic()
         rc, out, err = _spawn(
             ["--child", "--child-model", "mobilenetv2",
              "--child-batch", "512", "--child-dtypes", "bfloat16,float32"],
             accel_timeout,
         )
+        child_secs = time.monotonic() - t_child
         line = _json_line(out)
         if rc == 0 and line:
             parsed = json.loads(line)
             if parsed.get("platform") != "cpu":
                 print(line, flush=True)
                 return
+            # cpu fallback is itself a common transient-dial symptom (the
+            # plugin errored and jax degraded) — retry-eligible below.
             accel_err = "backend fell back to cpu platform"
             log(accel_err)
         else:
@@ -401,8 +414,12 @@ def main() -> None:
                     f" — child hung {where}; device tunnel unreachable?"
                 )
             log(f"accelerator child failed (rc={rc}): {accel_err}")
-    else:
-        accel_err = "no budget left for accelerator child"
+        # Retry once on a FAST failure (crash or quick cpu degrade — a
+        # transient); a timed-out child already consumed its patience.
+        fast_failure = rc is not None and child_secs < 60
+        if not (fast_failure and attempts < 2):
+            break
+        log("fast failure; retrying once")
 
     # --- degraded mode: tinycnn on the virtual-CPU mesh, same mechanism --
     # (full MobileNetV2 takes ~10 min to COMPILE on a 1-core CPU host; a
