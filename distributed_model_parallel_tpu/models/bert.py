@@ -50,6 +50,27 @@ class BertConfig:
 BERT_BASE = BertConfig()
 
 
+def embed_apply(params, ids, cfg: BertConfig, ln: L.Layer, drop: L.Layer,
+                ctx, *, positions=None):
+    """The embedding math, shared by the dense stem Layer and the
+    sequence-parallel engine (`parallel/sequence_parallel.py` passes its
+    shard's `positions` slice; one copy of the math, no drift).
+    Returns (hidden, mask)."""
+    mask = ids != cfg.pad_token_id
+    if positions is None:
+        positions = params["position"][: ids.shape[1]]
+    h = (
+        jnp.take(params["word"], ids, axis=0)
+        + positions[None, :, :]
+        + params["token_type"][0][None, None, :]
+    )
+    if ctx.dtype is not None:  # mixed precision enters here (int inputs)
+        h = h.astype(ctx.dtype)
+    h, _ = ln.apply(params["ln"], {}, h, ctx)
+    h, _ = drop.apply({}, {}, h, ctx)
+    return h, mask
+
+
 def _embeddings(cfg: BertConfig) -> L.Layer:
     """word + position + token-type embeddings, LN, dropout. Input: int ids
     (B, T) (token-type ids all zero — single-segment; the classification
@@ -74,15 +95,7 @@ def _embeddings(cfg: BertConfig) -> L.Layer:
         return params, {}
 
     def apply(params, state, ids, ctx):
-        t = ids.shape[1]
-        mask = ids != cfg.pad_token_id
-        h = (
-            jnp.take(params["word"], ids, axis=0)
-            + params["position"][None, :t, :]
-            + params["token_type"][0][None, None, :]
-        )
-        h, _ = ln.apply(params["ln"], {}, h, ctx)
-        h, _ = drop.apply({}, {}, h, ctx)
+        h, mask = embed_apply(params, ids, cfg, ln, drop, ctx)
         return (h, mask), state
 
     return L.Layer(init, apply)
@@ -126,13 +139,20 @@ def _cls_head(cfg: BertConfig, num_classes: int) -> L.Layer:
 
     def apply(params, state, x, ctx):
         h, _ = x
-        pooled = jnp.tanh(
-            h[:, 0, :] @ params["pooler"]["w"] + params["pooler"]["b"]
-        )
-        logits = pooled @ params["classifier"]["w"] + params["classifier"]["b"]
-        return logits, state
+        return head_apply(params, h[:, 0, :]), state
 
     return L.Layer(init, apply)
+
+
+def head_apply(params, h_cls):
+    """Pooler+classifier math on the [CLS] hidden state, shared with the
+    sequence-parallel engine (which feeds its shard's local token 0).
+    Computed in f32 (bf16-safe logits)."""
+    pooled = jnp.tanh(
+        h_cls.astype(jnp.float32) @ params["pooler"]["w"]
+        + params["pooler"]["b"]
+    )
+    return pooled @ params["classifier"]["w"] + params["classifier"]["b"]
 
 
 def bert_for_classification(

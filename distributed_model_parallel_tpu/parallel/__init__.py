@@ -6,6 +6,9 @@ from distributed_model_parallel_tpu.parallel.data_parallel import (  # noqa: F40
 from distributed_model_parallel_tpu.parallel.pipeline import (  # noqa: F401
     PipelineEngine,
 )
+from distributed_model_parallel_tpu.parallel.sequence_parallel import (  # noqa: F401
+    SequenceParallelEngine,
+)
 from distributed_model_parallel_tpu.parallel.tensor_parallel import (  # noqa: F401
     MEGATRON_RULES,
     TensorParallelEngine,

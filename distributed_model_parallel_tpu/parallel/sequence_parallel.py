@@ -1,0 +1,213 @@
+"""Sequence/context-parallel training engine — activations sharded over
+`'seq'`.
+
+Long-context training for the transformer family: token activations
+(B, T, D) are sharded T/N per device over the `'seq'` mesh axis, so the
+per-device activation (and attention working-set) memory scales 1/N with
+the ring — the reason context parallelism exists. Attention is the only
+cross-token op; it runs through `ops.ring_attention.ring_attention`
+(K/V rotating over ICI, exact online-softmax) or `ulysses_attention`
+(all-to-all head scatter). Everything else (LayerNorm, FFN, dropout) is
+per-token and needs no communication. Parameters stay replicated
+(compose with the 'model' axis / TensorParallelEngine for weight
+sharding).
+
+Mirrors the pipeline engine's autodiff discipline (`parallel/pipeline.py`):
+the loss is computed ONLY on the shard that owns the [CLS] token (global
+position 0 lives on seq-shard 0) and kept local — no psum before
+`jax.grad` — so under `check_vma=False` no differentiated cross-device
+reduction exists; the reversed ring permutes / all-to-alls alone carry
+cotangents between shards, and the complementary per-shard param grads
+are psum'd over 'seq' after grad (+ pmean over 'data').
+
+The reference has nothing in this category (SURVEY.md §5: long-context
+"entirely absent"); this engine exists because the framework treats
+long-sequence training as first-class.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+from jax import lax, shard_map
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from distributed_model_parallel_tpu.models import layers as L
+from distributed_model_parallel_tpu.models.bert import (
+    BertConfig,
+    _cls_head,
+    _embeddings,
+    _encoder_blocks,
+    embed_apply,
+    head_apply,
+)
+from distributed_model_parallel_tpu.ops.ring_attention import (
+    ring_attention,
+    ulysses_attention,
+)
+from distributed_model_parallel_tpu.parallel.data_parallel import (
+    TrainState,
+    _metrics,
+    _place_batch,
+)
+from distributed_model_parallel_tpu.training.metrics import cross_entropy
+from distributed_model_parallel_tpu.training.optim import SGD
+
+ATTENTION = {"ring": ring_attention, "ulysses": ulysses_attention}
+
+
+@dataclasses.dataclass
+class SequenceParallelEngine:
+    """BERT-family classification training with 'seq'-sharded activations.
+
+    Parameters are IDENTICAL in structure to
+    `bert_for_classification(num_classes, cfg)` — checkpoints and the
+    transformers-weight transplant (tests/test_bert.py) interoperate.
+    The global sequence length must be divisible by the 'seq' axis size
+    (and, for 'ulysses', heads by the axis size)."""
+
+    cfg: BertConfig
+    num_classes: int
+    optimizer: SGD
+    mesh: Mesh
+    attention: str = "ring"
+    donate: bool = True
+    compute_dtype: Any = None
+
+    def __post_init__(self):
+        mesh = self.mesh
+        if "seq" not in mesh.axis_names:
+            raise ValueError("sequence-parallel mesh needs a 'seq' axis")
+        if self.attention not in ATTENTION:
+            raise ValueError(
+                f"attention must be one of {sorted(ATTENTION)}, "
+                f"got {self.attention!r}"
+            )
+        cfg = self.cfg
+        attn_fn = partial(ATTENTION[self.attention], axis_name="seq")
+        self._repl = NamedSharding(mesh, P())
+        self._batch = NamedSharding(mesh, P(("data",), ("seq",)))
+        self._labels = NamedSharding(mesh, P(("data",)))
+        self._blocks = L.sequential(*_encoder_blocks(cfg, attn_fn))
+        self._full = L.named([
+            ("stem", _embeddings(cfg)),
+            ("blocks", self._blocks),
+            ("head", _cls_head(cfg, self.num_classes)),
+        ])
+        self._ln = L.layernorm(cfg.hidden_size, eps=cfg.layer_norm_eps)
+        self._drop = L.dropout(cfg.dropout_rate)
+        # Encoder layers are stateless; sequential still wants its keyed
+        # (empty) state dict.
+        blocks_state = {str(i): {} for i in range(cfg.num_layers)}
+        cdt = self.compute_dtype
+
+        def forward(params, ids, ctx):
+            """Seq-sharded forward on ONE device: local ids (Bl, Tl).
+            The SAME stem/head math as the dense model (shared
+            `embed_apply`/`head_apply` from models/bert.py), with the two
+            position-dependent pieces made shard-aware: the position
+            embedding slice starts at this shard's global offset, and the
+            [CLS] pooler reads shard 0's local token 0."""
+            tl = ids.shape[1]
+            s_idx = lax.axis_index("seq")
+            pos = lax.dynamic_slice_in_dim(
+                params["stem"]["position"], s_idx * tl, tl, axis=0
+            )
+            h, mask = embed_apply(
+                params["stem"], ids, cfg, self._ln, self._drop,
+                ctx.child(0), positions=pos,
+            )
+            (h, _), _ = self._blocks.apply(
+                params["blocks"], blocks_state, (h, mask), ctx.child(1)
+            )
+            logits = head_apply(params["head"], h[:, 0, :])
+            # Only seq-shard 0's position 0 is the global [CLS]; other
+            # shards' logits are garbage and masked out of loss/metrics.
+            is_cls_shard = (s_idx == 0).astype(logits.dtype)
+            return logits, is_cls_shard
+
+        def shard_step(ts: TrainState, ids, labels, lr):
+            rng = jax.random.fold_in(
+                jax.random.fold_in(
+                    jax.random.fold_in(jax.random.PRNGKey(0), ts.step),
+                    lax.axis_index("data"),
+                ),
+                lax.axis_index("seq"),
+            )
+            ctx = L.Context(train=True, rng=rng, dtype=cdt)
+
+            def loss_fn(params):
+                logits, is_cls = forward(params, ids, ctx)
+                # Local loss (pipeline discipline: no psum before grad).
+                loss = cross_entropy(logits, labels) * is_cls
+                return loss, (logits, is_cls)
+
+            (loss, (logits, is_cls)), grads = jax.value_and_grad(
+                loss_fn, has_aux=True
+            )(ts.params)
+            # Per-shard grads are complementary pieces of the total
+            # (each shard's tokens feed the rings); sum over 'seq',
+            # average over 'data' — one fused all-reduce.
+            grads = jax.tree_util.tree_map(
+                lambda g: lax.pmean(lax.psum(g, "seq"), "data"), grads
+            )
+            params, opt_state = self.optimizer.update(
+                ts.params, ts.opt_state, grads, lr
+            )
+            new_ts = TrainState(
+                params, ts.model_state, opt_state, ts.step + 1
+            )
+            m = _metrics(loss, logits, labels)
+            m = {
+                k: lax.psum(v * is_cls, ("seq", "data"))
+                for k, v in m.items()
+            }
+            return new_ts, m
+
+        def shard_eval(ts: TrainState, ids, labels):
+            logits, is_cls = forward(
+                ts.params, ids, L.Context(train=False, dtype=cdt)
+            )
+            loss = cross_entropy(logits, labels) * is_cls
+            m = _metrics(loss, logits, labels)
+            return {
+                k: lax.psum(v * is_cls, ("seq", "data"))
+                for k, v in m.items()
+            }
+
+        donate = (0,) if self.donate else ()
+        self.train_step = jax.jit(
+            shard_map(
+                shard_step, mesh=mesh,
+                in_specs=(P(), P(("data",), ("seq",)), P(("data",)), P()),
+                out_specs=(P(), P()),
+                check_vma=False,
+            ),
+            donate_argnums=donate,
+        )
+        self.eval_step = jax.jit(
+            shard_map(
+                shard_eval, mesh=mesh,
+                in_specs=(P(), P(("data",), ("seq",)), P(("data",))),
+                out_specs=P(),
+                check_vma=False,
+            )
+        )
+
+    def init_state(self, rng: jax.Array) -> TrainState:
+        params, model_state = self._full.init(rng)
+        opt_state = self.optimizer.init(params)
+        ts = TrainState(
+            params, model_state, opt_state, jnp.zeros((), jnp.int32)
+        )
+        return jax.device_put(ts, self._repl)
+
+    def shard_batch(self, ids, labels):
+        """ids shard over ('data', 'seq'); labels over 'data' only."""
+        ids_arr = _place_batch((ids,), self._batch)[0]
+        labels_arr = _place_batch((labels,), self._labels)[0]
+        return ids_arr, labels_arr
