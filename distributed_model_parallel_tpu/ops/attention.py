@@ -27,13 +27,16 @@ def dot_product_attention(
     mask: Optional[jax.Array] = None,
     *,
     scale: Optional[float] = None,
+    causal: bool = False,
 ) -> jax.Array:
     """softmax(q k^T / sqrt(dh)) v over (B, T, H, Dh) tensors.
 
     `mask`: boolean (B, Tkv) key-validity mask (True = attend) or a
     broadcastable additive-logit-compatible boolean of shape
-    (B, 1|H, Tq, Tkv). Computation in f32 regardless of input dtype
-    (softmax stability on bf16 inputs), result cast back.
+    (B, 1|H, Tq, Tkv). `causal=True` additionally restricts each query
+    to keys at its own position or earlier (decoder-style models).
+    Computation in f32 regardless of input dtype (softmax stability on
+    bf16 inputs), result cast back.
     """
     dh = q.shape[-1]
     scale = scale if scale is not None else 1.0 / jnp.sqrt(dh).astype(
@@ -43,10 +46,17 @@ def dot_product_attention(
     kf = k.astype(jnp.float32)
     # (B, H, Tq, Tkv)
     logits = jnp.einsum("bqhd,bkhd->bhqk", qf, kf) * scale
+    neg = jnp.finfo(jnp.float32).min
     if mask is not None:
         if mask.ndim == 2:  # (B, Tkv) key mask
             mask = mask[:, None, None, :]
-        logits = jnp.where(mask, logits, jnp.finfo(jnp.float32).min)
+        logits = jnp.where(mask, logits, neg)
+    if causal:
+        tq, tk = q.shape[1], k.shape[1]
+        tri = (
+            jnp.arange(tq)[:, None] >= jnp.arange(tk)[None, :]
+        )  # (Tq, Tkv)
+        logits = jnp.where(tri[None, None, :, :], logits, neg)
     weights = jax.nn.softmax(logits, axis=-1)
     out = jnp.einsum("bhqk,bkhd->bqhd", weights, v.astype(jnp.float32))
     return out.astype(q.dtype)

@@ -48,8 +48,12 @@ _NEG = jnp.finfo(jnp.float32).min
 
 
 def _flash_step(q_ref, k_ref, v_ref, valid, o_ref,
-                m_scr, l_scr, acc_scr, scale: float, nk: int):
+                m_scr, l_scr, acc_scr, scale: float, nk: int,
+                causal: bool = False):
     ki = pl.program_id(3)
+    qi = pl.program_id(2)  # hoisted: program_id may not be called inside
+    bq = q_ref.shape[2]    # the pl.when branch (no lowering rule there)
+    bk = k_ref.shape[2]
 
     @pl.when(ki == 0)
     def _():
@@ -57,31 +61,48 @@ def _flash_step(q_ref, k_ref, v_ref, valid, o_ref,
         l_scr[:] = jnp.zeros_like(l_scr[:])
         acc_scr[:] = jnp.zeros_like(acc_scr[:])
 
-    q = q_ref[0, 0].astype(jnp.float32) * scale          # (bq, dh)
-    k = k_ref[0, 0].astype(jnp.float32)                  # (bk, dh)
-    v = v_ref[0, 0].astype(jnp.float32)                  # (bk, dh)
+    def compute():
+        q = q_ref[0, 0].astype(jnp.float32) * scale      # (bq, dh)
+        k = k_ref[0, 0].astype(jnp.float32)              # (bk, dh)
+        v = v_ref[0, 0].astype(jnp.float32)              # (bk, dh)
 
-    s = jax.lax.dot_general(                             # (bq, bk) on MXU
-        q, k,
-        dimension_numbers=(((1,), (1,)), ((), ())),
-        preferred_element_type=jnp.float32,
-    )
-    if valid is not None:  # static: masked kernel variant only
-        s = jnp.where(valid[None, :], s, _NEG)
+        s = jax.lax.dot_general(                         # (bq, bk) on MXU
+            q, k,
+            dimension_numbers=(((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        if valid is not None:  # static: masked kernel variant only
+            s = jnp.where(valid[None, :], s, _NEG)
+        if causal:  # global row >= global col within this tile pair
+            rows = qi * bq + jax.lax.broadcasted_iota(
+                jnp.int32, (bq, bk), 0
+            )
+            cols = ki * bk + jax.lax.broadcasted_iota(
+                jnp.int32, (bq, bk), 1
+            )
+            s = jnp.where(rows >= cols, s, _NEG)
 
-    m_prev = m_scr[:, 0]                                 # (bq,)
-    m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1))
-    # exp(_NEG - m_new) underflows to 0 for any finite m_new; an
-    # all-masked prefix keeps l == 0 and is guarded at finalize.
-    p = jnp.exp(s - m_new[:, None])                      # (bq, bk)
-    corr = jnp.exp(m_prev - m_new)                       # (bq,)
-    l_scr[:, 0] = l_scr[:, 0] * corr + jnp.sum(p, axis=-1)
-    acc_scr[:] = acc_scr[:] * corr[:, None] + jax.lax.dot_general(
-        p, v,
-        dimension_numbers=(((1,), (0,)), ((), ())),
-        preferred_element_type=jnp.float32,
-    )
-    m_scr[:, 0] = m_new
+        m_prev = m_scr[:, 0]                             # (bq,)
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1))
+        # exp(_NEG - m_new) underflows to 0 for any finite m_new; an
+        # all-masked prefix keeps l == 0 and is guarded at finalize.
+        p = jnp.exp(s - m_new[:, None])                  # (bq, bk)
+        corr = jnp.exp(m_prev - m_new)                   # (bq,)
+        l_scr[:, 0] = l_scr[:, 0] * corr + jnp.sum(p, axis=-1)
+        acc_scr[:] = acc_scr[:] * corr[:, None] + jax.lax.dot_general(
+            p, v,
+            dimension_numbers=(((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        m_scr[:, 0] = m_new
+
+    if causal:
+        # Skip tiles strictly above the causal frontier: their logits
+        # would all be _NEG and contribute nothing, but the MXU work and
+        # K/V DMA are ~half the grid at long T — predicate them away.
+        pl.when(ki * bk <= qi * bq + bq - 1)(compute)
+    else:
+        compute()
 
     @pl.when(ki == nk - 1)
     def _():
@@ -91,17 +112,19 @@ def _flash_step(q_ref, k_ref, v_ref, valid, o_ref,
 
 
 def _flash_kernel(q_ref, k_ref, v_ref, mask_ref, o_ref,
-                  m_scr, l_scr, acc_scr, *, scale: float, nk: int):
+                  m_scr, l_scr, acc_scr, *, scale: float, nk: int,
+                  causal: bool):
     _flash_step(q_ref, k_ref, v_ref, mask_ref[0] != 0, o_ref,
-                m_scr, l_scr, acc_scr, scale, nk)
+                m_scr, l_scr, acc_scr, scale, nk, causal)
 
 
 def _flash_kernel_nomask(q_ref, k_ref, v_ref, o_ref,
-                         m_scr, l_scr, acc_scr, *, scale: float, nk: int):
+                         m_scr, l_scr, acc_scr, *, scale: float, nk: int,
+                         causal: bool):
     # mask=None specialization: no dummy mask streamed per grid step, no
     # per-tile where on the hot path.
     _flash_step(q_ref, k_ref, v_ref, None, o_ref,
-                m_scr, l_scr, acc_scr, scale, nk)
+                m_scr, l_scr, acc_scr, scale, nk, causal)
 
 
 def _pick_block(t: int, want: int) -> int:
@@ -113,7 +136,8 @@ def _pick_block(t: int, want: int) -> int:
     return b
 
 
-def _flash_forward(q, k, v, mask, scale, block_q, block_k, interpret):
+def _flash_forward(q, k, v, mask, scale, block_q, block_k, interpret,
+                   causal=False):
     b, tq, h, dh = q.shape
     tk = k.shape[1]
     bq = _pick_block(tq, block_q)
@@ -122,7 +146,9 @@ def _flash_forward(q, k, v, mask, scale, block_q, block_k, interpret):
         # Awkward sequence lengths (prime/odd) would force sub-sublane
         # blocks — a silent performance cliff and a Mosaic tiling risk.
         # The XLA path is the better program there.
-        return dot_product_attention(q, k, v, mask, scale=scale)
+        return dot_product_attention(
+            q, k, v, mask, scale=scale, causal=causal
+        )
     nq, nk = tq // bq, tk // bk
 
     # (B, H, T, Dh) layout for clean (seq, head_dim) blocks.
@@ -135,13 +161,17 @@ def _flash_forward(q, k, v, mask, scale, block_q, block_k, interpret):
     operands = [qt, kt, vt]
     in_specs = [qspec, kspec, kspec]
     if mask is not None:
-        kernel = functools.partial(_flash_kernel, scale=scale, nk=nk)
+        kernel = functools.partial(
+            _flash_kernel, scale=scale, nk=nk, causal=causal
+        )
         operands.append(mask.astype(jnp.int8))
         in_specs.append(
             pl.BlockSpec((1, bk), lambda bi, hi, qi, ki: (bi, ki))
         )
     else:
-        kernel = functools.partial(_flash_kernel_nomask, scale=scale, nk=nk)
+        kernel = functools.partial(
+            _flash_kernel_nomask, scale=scale, nk=nk, causal=causal
+        )
     out = pl.pallas_call(
         kernel,
         grid=(b, h, nq, nk),
@@ -160,22 +190,28 @@ def _flash_forward(q, k, v, mask, scale, block_q, block_k, interpret):
     return jnp.transpose(out, (0, 2, 1, 3))
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7))
-def _flash(q, k, v, mask, scale, block_q, block_k, interpret):
-    return _flash_forward(q, k, v, mask, scale, block_q, block_k, interpret)
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7, 8))
+def _flash(q, k, v, mask, scale, block_q, block_k, interpret, causal):
+    return _flash_forward(
+        q, k, v, mask, scale, block_q, block_k, interpret, causal
+    )
 
 
-def _flash_fwd(q, k, v, mask, scale, block_q, block_k, interpret):
-    out = _flash_forward(q, k, v, mask, scale, block_q, block_k, interpret)
+def _flash_fwd(q, k, v, mask, scale, block_q, block_k, interpret, causal):
+    out = _flash_forward(
+        q, k, v, mask, scale, block_q, block_k, interpret, causal
+    )
     return out, (q, k, v, mask)
 
 
-def _flash_bwd(scale, block_q, block_k, interpret, res, g):
+def _flash_bwd(scale, block_q, block_k, interpret, causal, res, g):
     # Exact gradients by recomputing attention through the XLA reference
     # path (see module docstring).
     q, k, v, mask = res
     _, vjp = jax.vjp(
-        lambda q, k, v: dot_product_attention(q, k, v, mask, scale=scale),
+        lambda q, k, v: dot_product_attention(
+            q, k, v, mask, scale=scale, causal=causal
+        ),
         q, k, v,
     )
     dq, dk, dv = vjp(g)
@@ -192,6 +228,7 @@ def flash_attention(
     mask: Optional[jax.Array] = None,
     *,
     scale: Optional[float] = None,
+    causal: bool = False,
     block_q: int = 128,
     block_k: int = 128,
     interpret: Optional[bool] = None,
@@ -215,4 +252,4 @@ def flash_attention(
             "flash_attention supports (B, Tkv) key-validity masks; use "
             "dot_product_attention for general logit masks"
         )
-    return _flash(q, k, v, mask, scale, block_q, block_k, interpret)
+    return _flash(q, k, v, mask, scale, block_q, block_k, interpret, causal)

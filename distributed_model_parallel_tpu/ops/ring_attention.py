@@ -21,10 +21,10 @@ of this framework's capability surface.
 
 Both compute in f32 and cast back to the input dtype (bf16-safe), match
 `dot_product_attention` numerically (tests/test_sequence_parallel.py,
-forward AND gradients), and support the (B, Tkv) key-validity mask.
-Causal masking is not implemented (the model zoo's flagship transformer
-is BERT — bidirectional); a causal variant adds a block-index predicate
-to the same recurrence.
+forward AND gradients), support the (B, Tkv) key-validity mask, and take
+`causal=True` for decoder-style models (the ring applies it as a
+block-index predicate on the rotating KV blocks; Ulysses applies the
+ordinary triangle after its all-to-all).
 """
 
 from __future__ import annotations
@@ -51,6 +51,7 @@ def ring_attention(
     *,
     axis_name: str = "seq",
     scale: Optional[float] = None,
+    causal: bool = False,
 ) -> jax.Array:
     """Exact attention over a ring of sequence shards.
 
@@ -58,11 +59,19 @@ def ring_attention(
     sequence axis: local shapes (B, T/N, H, dh), `mask` (B, T/N) key
     validity. Returns the local queries' attention over the FULL global
     key/value sequence.
+
+    `causal=True` applies GLOBAL-position causality with a block-level
+    predicate: the KV block arriving at ring step r originated on shard
+    (self - r) mod n, so it is fully visible when its shard index is
+    below ours, fully hidden when above, and lower-triangular for the
+    local block — no per-element global-index bookkeeping crosses the
+    wire.
     """
     dh = q.shape[-1]
     scale = scale if scale is not None else 1.0 / math.sqrt(dh)
     b, tq, h, _ = q.shape
     n = lax.psum(1, axis_name)  # static ring size
+    s_idx = lax.axis_index(axis_name)
     qf = q.astype(jnp.float32) * scale
     kb = k.astype(jnp.float32)
     vb = v.astype(jnp.float32)
@@ -77,10 +86,12 @@ def ring_attention(
     l0 = jnp.zeros((b, h, tq), jnp.float32)            # running denom
     o0 = jnp.zeros((b, tq, h, dh), jnp.float32)        # running numerator
 
-    def accumulate(acc, kb, vb, maskb):
+    def accumulate(acc, kb, vb, maskb, tri=None):
         m, l, o = acc
         logits = jnp.einsum("bqhd,bkhd->bhqk", qf, kb)
         logits = jnp.where(maskb[:, None, None, :], logits, _NEG)
+        if tri is not None:  # causal local block: (tq, tkv) triangle
+            logits = jnp.where(tri[None, None, :, :], logits, _NEG)
         m_new = jnp.maximum(m, jnp.max(logits, axis=-1))
         # exp(_NEG - m_new) underflows to 0 for any finite m_new; a fully
         # masked ring (pad-only rows) keeps l == 0 and is guarded below.
@@ -92,7 +103,7 @@ def ring_attention(
         )
         return m_new, l, o
 
-    def body(_, carry):
+    def body(r, carry):
         # Rotate THEN accumulate: the local block is consumed before the
         # loop, so exactly n-1 ring hops happen in total (a rotate-last
         # loop would pay one extra full K/V transfer whose result is
@@ -101,9 +112,21 @@ def ring_attention(
         kb, vb, maskb = (
             lax.ppermute(x, axis_name, perm) for x in (kb, vb, maskb)
         )
-        return accumulate(acc, kb, vb, maskb), kb, vb, maskb
+        step_mask = maskb
+        if causal:
+            # Block arriving at step r originated on shard (s - r - 1)
+            # mod n: visible iff it sits strictly below us in the global
+            # order.
+            src = (s_idx - r - 1) % n
+            step_mask = maskb & (src < s_idx)
+        return accumulate(acc, kb, vb, step_mask), kb, vb, maskb
 
-    acc = accumulate((m0, l0, o0), kb, vb, maskb)  # local block first
+    tri = None
+    if causal:
+        tri = (
+            jnp.arange(tq)[:, None] >= jnp.arange(k.shape[1])[None, :]
+        )
+    acc = accumulate((m0, l0, o0), kb, vb, maskb, tri)  # local block first
     (m, l, o), *_ = lax.fori_loop(0, n - 1, body, (acc, kb, vb, maskb))
     denom = jnp.where(l > 0, l, 1.0)
     out = o / jnp.transpose(denom, (0, 2, 1))[..., None]
@@ -118,6 +141,7 @@ def ulysses_attention(
     *,
     axis_name: str = "seq",
     scale: Optional[float] = None,
+    causal: bool = False,
 ) -> jax.Array:
     """All-to-all sequence parallelism (DeepSpeed-Ulysses layout swap).
 
@@ -147,7 +171,10 @@ def ulysses_attention(
     full_mask = None
     if mask is not None:
         full_mask = lax.all_gather(mask, axis_name, axis=1, tiled=True)
+    # After the all-to-all each device sees the FULL sequence for its
+    # heads, so causality is the ordinary triangular mask locally.
     out = dot_product_attention(
-        to_heads(q), to_heads(k), to_heads(v), full_mask, scale=scale
+        to_heads(q), to_heads(k), to_heads(v), full_mask, scale=scale,
+        causal=causal,
     )
     return to_seq(out)

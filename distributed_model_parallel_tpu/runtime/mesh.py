@@ -8,10 +8,12 @@ named `jax.sharding.Mesh` over axes
     ('data', 'stage', 'model', 'seq')
 
 and every engine addresses devices by axis name:
-  data   — batch sharding + gradient psum (DP/DDP)
-  stage  — pipeline stages, activations move by ppermute (pipeline MP)
-  model  — tensor parallelism (open axis; absent in reference, first-class here)
-  seq    — sequence/context parallelism (ring attention / Ulysses all-to-all)
+  data   — batch sharding + gradient psum (DataParallelEngine/DDPEngine)
+  stage  — pipeline stages, activations move by ppermute (PipelineEngine)
+  model  — tensor parallelism, Megatron weight shardings
+           (TensorParallelEngine)
+  seq    — sequence/context parallelism, ring attention / Ulysses
+           all-to-all (SequenceParallelEngine)
 
 A `MeshSpec` replaces `--world-size N`: any axis left at -1 absorbs the
 remaining devices, so `MeshSpec(stage=4)` on 8 chips gives a (2, 4, 1, 1)

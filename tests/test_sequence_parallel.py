@@ -68,18 +68,31 @@ def test_forward_matches_full_attention(sp_mesh, attn_fn):
     )
 
 
+@pytest.mark.parametrize("causal", [False, True])
 @pytest.mark.parametrize("attn_fn", [ring_attention, ulysses_attention])
-def test_gradients_match_full_attention(sp_mesh, attn_fn):
+def test_gradients_match_full_attention(sp_mesh, attn_fn, causal):
     """Cotangents cross shards through the reversed ppermutes /
-    all-to-alls; the grads wrt q, k, v must match the dense reference."""
+    all-to-alls; the grads wrt q, k, v must match the dense reference —
+    with and without the causal block predicate."""
     q, k, v, mask = _qkv(seed=3)
-    sharded = _sharded_attn(attn_fn, sp_mesh)
+    spec = P(None, ("seq",))
+    sharded = jax.jit(
+        shard_map(
+            partial(attn_fn, axis_name="seq", causal=causal),
+            mesh=sp_mesh,
+            in_specs=(spec, spec, spec, P(None, ("seq",))),
+            out_specs=spec,
+            check_vma=False,
+        )
+    )
 
     def loss_sharded(q, k, v):
         return jnp.sum(jnp.square(sharded(q, k, v, mask)))
 
     def loss_dense(q, k, v):
-        return jnp.sum(jnp.square(dot_product_attention(q, k, v, mask)))
+        return jnp.sum(jnp.square(
+            dot_product_attention(q, k, v, mask, causal=causal)
+        ))
 
     got = jax.grad(loss_sharded, argnums=(0, 1, 2))(q, k, v)
     want = jax.grad(loss_dense, argnums=(0, 1, 2))(q, k, v)
@@ -247,3 +260,74 @@ def test_sequence_parallel_eval_and_checkpoint_interop(sp_mesh):
     m = sp.eval_step(ts, *sp.shard_batch(ids, labels))
     assert float(m["count"]) == 8
     assert np.isfinite(float(m["loss_sum"]))
+
+
+# ---------------------------------------------------------------------------
+# Causal attention (decoder-style) across all attention implementations.
+# ---------------------------------------------------------------------------
+
+
+def test_causal_dense_reference_is_triangular():
+    """Numpy ground truth: each query only attends to keys <= its
+    position."""
+    q, k, v, _ = _qkv(seed=9)
+    out = dot_product_attention(q, k, v, causal=True)
+    # Query 0 can only see key 0: its output must equal v[:, 0] exactly.
+    np.testing.assert_allclose(
+        np.asarray(out[:, 0]), np.asarray(v[:, 0]), rtol=1e-6
+    )
+    # And changing a FUTURE key must not change past outputs.
+    v2 = v.at[:, -1].set(0.0)
+    out2 = dot_product_attention(q, k, v2, causal=True)
+    np.testing.assert_allclose(
+        np.asarray(out[:, :-1]), np.asarray(out2[:, :-1]), rtol=1e-6
+    )
+
+
+@pytest.mark.parametrize("attn_fn", [ring_attention, ulysses_attention])
+def test_causal_sharded_matches_dense(sp_mesh, attn_fn):
+    """Causality with global positions survives sequence sharding: the
+    ring's block-index predicate == the dense triangle."""
+    q, k, v, mask = _qkv(seed=10)
+    want = dot_product_attention(q, k, v, mask, causal=True)
+    spec = P(None, ("seq",))
+    sharded = jax.jit(
+        shard_map(
+            partial(attn_fn, axis_name="seq", causal=True),
+            mesh=sp_mesh,
+            in_specs=(spec, spec, spec, P(None, ("seq",))),
+            out_specs=spec,
+            check_vma=False,
+        )
+    )
+    got = sharded(q, k, v, mask)
+    np.testing.assert_allclose(
+        np.asarray(got), np.asarray(want), rtol=1e-5, atol=1e-5
+    )
+
+
+def test_causal_flash_matches_dense():
+    from distributed_model_parallel_tpu.ops.pallas_attention import (
+        flash_attention,
+    )
+
+    rng = np.random.RandomState(11)
+    t = 128
+    mk = lambda: jnp.asarray(rng.randn(2, t, 4, 16).astype(np.float32))
+    q, k, v = mk(), mk(), mk()
+    mask = jnp.asarray(rng.rand(2, t) > 0.2).at[:, 0].set(True)
+    want = dot_product_attention(q, k, v, mask, causal=True)
+    got = flash_attention(q, k, v, mask, causal=True, block_q=32, block_k=32)
+    np.testing.assert_allclose(
+        np.asarray(got), np.asarray(want), rtol=1e-5, atol=1e-5
+    )
+    # grads through the causal custom_vjp
+    g1 = jax.grad(lambda q: jnp.sum(jnp.square(
+        flash_attention(q, k, v, mask, causal=True, block_q=32, block_k=32)
+    )))(q)
+    g2 = jax.grad(lambda q: jnp.sum(jnp.square(
+        dot_product_attention(q, k, v, mask, causal=True)
+    )))(q)
+    np.testing.assert_allclose(
+        np.asarray(g1), np.asarray(g2), rtol=2e-4, atol=2e-5
+    )
