@@ -84,11 +84,11 @@ def log(msg: str) -> None:
 # --------------------------------------------------------------- child side
 
 
-def _fake_batch(batch: int, seed: int = 0):
+def _fake_batch(batch: int, seed: int = 0, hw: int = 32):
     import numpy as np
 
     rng = np.random.RandomState(seed)
-    images = rng.rand(batch, 32, 32, 3).astype(np.float32)
+    images = rng.rand(batch, hw, hw, 3).astype(np.float32)
     labels = rng.randint(0, 10, size=(batch,)).astype(np.int32)
     return images, labels
 
@@ -118,6 +118,21 @@ def _aot_step(engine, state, images, labels, lr):
         ), None
 
 
+def _bench_models():
+    """Single registry: name -> (builder, input height/width). resnet50
+    at 224 is the BASELINE.json north-star workload (ResNet-50
+    images/sec/chip)."""
+    from distributed_model_parallel_tpu.models.mobilenetv2 import mobilenet_v2
+    from distributed_model_parallel_tpu.models.resnet import resnet50
+    from distributed_model_parallel_tpu.models.tinycnn import tiny_cnn
+
+    return {
+        "mobilenetv2": (lambda: mobilenet_v2(10), 32),
+        "tinycnn": (lambda: tiny_cnn(10), 32),
+        "resnet50": (lambda: resnet50(1000), 224),
+    }
+
+
 def _measure(model_name: str, batch: int, dtype_name: str,
              warmup: int, iters: int):
     """One throughput measurement on the already-initialized backend.
@@ -125,22 +140,20 @@ def _measure(model_name: str, batch: int, dtype_name: str,
     import jax
     import jax.numpy as jnp
 
-    from distributed_model_parallel_tpu.models.mobilenetv2 import mobilenet_v2
-    from distributed_model_parallel_tpu.models.tinycnn import tiny_cnn
     from distributed_model_parallel_tpu.parallel.data_parallel import (
         DataParallelEngine,
     )
     from distributed_model_parallel_tpu.runtime.mesh import MeshSpec, make_mesh
     from distributed_model_parallel_tpu.training.optim import SGD
 
-    model = {"mobilenetv2": mobilenet_v2, "tinycnn": tiny_cnn}[model_name](10)
+    builder, hw = _bench_models()[model_name]
     cdt = {"bfloat16": jnp.bfloat16, "float32": None}[dtype_name]
     mesh = make_mesh(MeshSpec(data=-1))
     engine = DataParallelEngine(
-        model=model, optimizer=SGD(), mesh=mesh, compute_dtype=cdt,
+        model=builder(), optimizer=SGD(), mesh=mesh, compute_dtype=cdt,
     )
     state = engine.init_state(jax.random.PRNGKey(0))
-    images, labels = engine.shard_batch(*_fake_batch(batch))
+    images, labels = engine.shard_batch(*_fake_batch(batch, hw=hw))
     lr = jnp.float32(0.2)
 
     log(f"compiling {model_name} batch={batch} dtype={dtype_name} ...")
@@ -212,12 +225,18 @@ def run_child(model_name: str, batch: int, dtypes: list[str],
         )
         log(f"{dtype_name}: {results[dtype_name]['img_per_sec']:.1f} img/s")
 
+    peak = peak_bf16_flops(device_kind)
+
+    def mfu_of(r):
+        if r["flops_per_step"] and peak:
+            return round(
+                r["flops_per_step"] / r["sec_per_step"] / (n_chips * peak),
+                4,
+            )
+        return None
+
     head_dtype = dtypes[0]
     head = results[head_dtype]
-    mfu = None
-    peak = peak_bf16_flops(device_kind)
-    if head["flops_per_step"] and peak:
-        mfu = head["flops_per_step"] / head["sec_per_step"] / (n_chips * peak)
     extra = {
         "platform": platform,
         "device_kind": device_kind,
@@ -226,15 +245,34 @@ def run_child(model_name: str, batch: int, dtypes: list[str],
         "batch": batch,
         "dtype": head_dtype,
         "sec_per_step": round(head["sec_per_step"], 4),
-        "mfu": round(mfu, 4) if mfu is not None else None,
+        "mfu": mfu_of(head),
         "flops_per_step": head["flops_per_step"],
     }
     for other in dtypes[1:]:
         extra[f"{other}_img_per_sec"] = round(
             results[other]["img_per_sec"], 1
         )
+    # Emit the headline line NOW — if the parent's deadline kills us
+    # during the optional north-star measurement below, this line is
+    # already on stdout and the parent rescues it from the drain.
     emit(head["img_per_sec"], head["img_per_sec"] / BASELINE_IMG_PER_SEC,
          **extra)
+
+    if platform != "cpu" and model_name == "mobilenetv2":
+        # North-star secondary metric (BASELINE.json): ResNet-50
+        # images/sec/chip at 224², bf16. Re-emitted as an UPDATED line;
+        # the parent forwards only the last one.
+        log("north-star extra: resnet50 @ 224, bf16 ...")
+        rn = _measure("resnet50", 256, "bfloat16", warmup=3, iters=20)
+        extra.update({
+            "resnet50_img_per_sec_per_chip": round(
+                rn["img_per_sec"] / n_chips, 1
+            ),
+            "resnet50_batch": 256,
+            "resnet50_mfu": mfu_of(rn),
+        })
+        emit(head["img_per_sec"],
+             head["img_per_sec"] / BASELINE_IMG_PER_SEC, **extra)
 
 
 def run_child_scaling(max_devices: int) -> None:
@@ -393,9 +431,17 @@ def main() -> None:
         )
         child_secs = time.monotonic() - t_child
         line = _json_line(out)
-        if rc == 0 and line:
+        if line:
             parsed = json.loads(line)
             if parsed.get("platform") != "cpu":
+                # A valid accelerator line is a success regardless of how
+                # the child ENDED (rc 0, deadline kill, or a crash in the
+                # optional post-emit north-star extra) — the child emits
+                # the headline before the crash-prone extra work exactly
+                # so it can be rescued here.
+                if rc != 0:
+                    log(f"child ended rc={rc} after emitting a result; "
+                        "using it")
                 print(line, flush=True)
                 return
             # cpu fallback is itself a common transient-dial symptom (the
