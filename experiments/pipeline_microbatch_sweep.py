@@ -1,0 +1,107 @@
+"""Pipeline microbatch sweep: measured time/batch vs the bubble math.
+
+The reference's headline pipeline finding is that one-batch-in-flight
+model parallelism is ~4x slower than data parallelism
+(`/root/reference/Readme.md:283-292`) — a pure schedule artifact: with S
+stages and M microbatches the pipeline runs M+S-1 ticks for M microbatches
+of work, so time/batch scales like (M+S-1)/M (=S at the reference's M=1,
+->1 as M grows). This sweep measures that curve on the 4-stage engine and
+overlays the ideal, producing the schedule-analysis figure the
+reference's report format calls for (pic/).
+
+Run: python experiments/pipeline_microbatch_sweep.py
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from distributed_model_parallel_tpu.runtime.platform import force_cpu  # noqa: E402
+
+
+def main() -> None:
+    force_cpu(8)
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from distributed_model_parallel_tpu.models import layers as L
+    from distributed_model_parallel_tpu.parallel import PipelineEngine
+    from distributed_model_parallel_tpu.runtime.mesh import MeshSpec, make_mesh
+    from distributed_model_parallel_tpu.training.optim import SGD
+
+    S = 4
+    mesh = make_mesh(MeshSpec(data=2, stage=S))
+    stages = [
+        L.sequential(L.conv2d(3, 32, 3, stride=1, padding=1), L.relu()),
+        L.sequential(L.conv2d(32, 32, 3, stride=1, padding=1), L.relu()),
+        L.sequential(L.conv2d(32, 32, 3, stride=1, padding=1), L.relu()),
+        L.sequential(L.global_avg_pool(), L.linear(32, 10)),
+    ]
+    rng = np.random.RandomState(0)
+    batch = 64
+    images = rng.rand(batch, 8, 8, 3).astype(np.float32)
+    labels = rng.randint(0, 10, size=(batch,)).astype(np.int32)
+
+    rows = []
+    for m in (1, 2, 4, 8, 16):
+        engine = PipelineEngine(
+            stages, SGD(), mesh, num_microbatches=m, donate=False
+        )
+        ts = engine.init_state(jax.random.PRNGKey(0))
+        im, lb = engine.shard_batch(images, labels)
+        lr = jnp.float32(0.05)
+        for _ in range(2):  # compile + warm
+            ts, _ = engine.train_step(ts, im, lb, lr)
+        jax.block_until_ready(ts)
+        iters = 4
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            ts, _ = engine.train_step(ts, im, lb, lr)
+        jax.block_until_ready(ts)
+        dt = (time.perf_counter() - t0) / iters
+        rows.append({"M": m, "time_per_batch": dt})
+        print(f"M={m:>2}: {dt:.3f} s/batch", flush=True)
+
+    base = rows[0]["time_per_batch"]  # M=1: the reference's schedule
+    for r in rows:
+        m = r["M"]
+        r["speedup_vs_m1"] = round(base / r["time_per_batch"], 2)
+        # ideal time ratio t(M)/t(1) = (M+S-1) / (M*S)
+        r["ideal_speedup"] = round(m * S / (m + S - 1), 2)
+
+    os.makedirs("pic", exist_ok=True)
+    with open("experiments/pipeline_microbatch_sweep.json", "w") as f:
+        json.dump({"S": S, "batch": batch, "rows": rows}, f, indent=2)
+
+    import matplotlib
+
+    matplotlib.use("Agg")
+    import matplotlib.pyplot as plt
+
+    ms = [r["M"] for r in rows]
+    fig, ax = plt.subplots(figsize=(6, 4))
+    ax.plot(ms, [r["speedup_vs_m1"] for r in rows], marker="o",
+            label="measured")
+    ax.plot(ms, [r["ideal_speedup"] for r in rows], marker="s",
+            linestyle="--", label="ideal  M·S/(M+S−1)")
+    ax.set_xscale("log", base=2)
+    ax.set_xticks(ms)
+    ax.set_xticklabels(ms)
+    ax.set_xlabel("microbatches M")
+    ax.set_ylabel("speedup vs M=1 (reference schedule)")
+    ax.set_title(f"GPipe fill-drain: bubble (S−1)/(M+S−1), S={S}")
+    ax.grid(alpha=0.3)
+    ax.legend()
+    fig.tight_layout()
+    fig.savefig("pic/pipeline_microbatch_sweep.png", dpi=120)
+    print("wrote pic/pipeline_microbatch_sweep.png")
+
+
+if __name__ == "__main__":
+    main()
