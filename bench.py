@@ -275,24 +275,30 @@ def run_child(model_name: str, batch: int, dtypes: list[str],
              head["img_per_sec"] / BASELINE_IMG_PER_SEC, **extra)
 
 
-def run_child_scaling(max_devices: int) -> None:
-    """Weak-scaling sweep over the 'data' axis on virtual CPU devices:
-    images/sec/chip and efficiency vs N=1 (BASELINE.json north-star shape).
-    Per-chip batch is held constant (weak scaling)."""
-    from distributed_model_parallel_tpu.runtime.platform import force_cpu
-
+def run_child_scaling(max_devices: int, model_name: str = "tinycnn",
+                      platform: str = "cpu") -> None:
+    """Weak-scaling sweep over the 'data' axis: images/sec/chip and
+    efficiency vs N=1 (BASELINE.json north-star shape). Per-chip batch
+    is held constant (weak scaling). platform='cpu' (default) uses
+    virtual CPU devices (tunnel-proof CI harness, tinycnn-sized);
+    platform='default' dials the real backend and sweeps its chips —
+    pair with model_name='resnet50' for the north-star measurement on a
+    real multi-chip slice."""
     if max_devices < 1:
         raise ValueError(f"--max-devices must be >= 1, got {max_devices}")
-    force_cpu(max_devices)
+    if platform == "cpu":
+        from distributed_model_parallel_tpu.runtime.platform import force_cpu
+
+        force_cpu(max_devices)
 
     import jax
     import jax.numpy as jnp
 
-    from distributed_model_parallel_tpu.models.tinycnn import tiny_cnn
     from distributed_model_parallel_tpu.parallel.data_parallel import DDPEngine
     from distributed_model_parallel_tpu.runtime.mesh import MeshSpec, make_mesh
     from distributed_model_parallel_tpu.training.optim import SGD
 
+    builder, hw = _bench_models()[model_name]
     per_chip_batch = 64
     sizes = []
     n = 1
@@ -302,13 +308,15 @@ def run_child_scaling(max_devices: int) -> None:
     if sizes[-1] != max_devices:
         sizes.append(max_devices)  # non-power-of-two cap still measured
 
+    devices = jax.devices("cpu") if platform == "cpu" else jax.devices()
+    sizes = [n for n in sizes if n <= len(devices)]
     rows = []
     for n in sizes:
-        mesh = make_mesh(MeshSpec(data=n), devices=jax.devices("cpu")[:n])
-        engine = DDPEngine(model=tiny_cnn(10), optimizer=SGD(), mesh=mesh)
+        mesh = make_mesh(MeshSpec(data=n), devices=devices[:n])
+        engine = DDPEngine(model=builder(), optimizer=SGD(), mesh=mesh)
         state = engine.init_state(jax.random.PRNGKey(0))
         batch = per_chip_batch * n
-        images, labels = engine.shard_batch(*_fake_batch(batch))
+        images, labels = engine.shard_batch(*_fake_batch(batch, hw=hw))
         lr = jnp.float32(0.1)
         for _ in range(2):
             state, _ = engine.train_step(state, images, labels, lr)
@@ -504,6 +512,19 @@ if __name__ == "__main__":
     )
     parser.add_argument("--max-devices", type=int, default=8)
     parser.add_argument(
+        "--scaling-model", default="tinycnn",
+        choices=("tinycnn", "mobilenetv2", "resnet50"),
+        help="--scaling workload: tinycnn for the CPU CI mesh; resnet50 "
+             "(the BASELINE.json north-star) with --scaling-platform "
+             "default on a real slice",
+    )
+    parser.add_argument(
+        "--scaling-platform", default="cpu", choices=("cpu", "default"),
+        help="--scaling devices: 'cpu' = virtual CPU mesh (tunnel-proof "
+             "CI harness); 'default' = dial the real backend and sweep "
+             "its chips",
+    )
+    parser.add_argument(
         "--child", action="store_true",
         help="internal: run a measurement in-process (spawned by main)",
     )
@@ -521,7 +542,8 @@ if __name__ == "__main__":
                   args.child_dtypes.split(","), cpu=args.child_cpu)
         sys.exit(0)
     if args.child_scaling:
-        run_child_scaling(args.max_devices)
+        run_child_scaling(args.max_devices, args.scaling_model,
+                          args.scaling_platform)
         sys.exit(0)
 
     def on_alarm(signum, frame):
@@ -537,9 +559,15 @@ if __name__ == "__main__":
     signal.alarm(TOTAL_BUDGET_S + 30)
     try:
         if args.scaling:
+            env = (
+                _cpu_child_env(args.max_devices)
+                if args.scaling_platform == "cpu" else None
+            )
             rc, out, err = _spawn(
-                ["--child-scaling", "--max-devices", str(args.max_devices)],
-                TOTAL_BUDGET_S, env=_cpu_child_env(args.max_devices),
+                ["--child-scaling", "--max-devices", str(args.max_devices),
+                 "--scaling-model", args.scaling_model,
+                 "--scaling-platform", args.scaling_platform],
+                TOTAL_BUDGET_S, env=env,
             )
             if rc == 0 and out.strip():
                 print(out, end="", flush=True)
