@@ -21,3 +21,9 @@ from distributed_model_parallel_tpu.models.bert import (  # noqa: F401
     bert_base,
     bert_for_classification,
 )
+from distributed_model_parallel_tpu.models.gpt import (  # noqa: F401
+    GPTConfig,
+    gpt_lm,
+    lm_loss,
+    lm_loss_fn,
+)

@@ -1,0 +1,157 @@
+"""Decoder-only causal language model (GPT-1-style, post-LN).
+
+The reference has no attention model at all; BERT covers the encoder
+side of this framework's transformer capability, and this module covers
+the decoder side — the consumer of `causal=True` attention
+(`ops/attention.py`, `ops/ring_attention.py`, `ops/pallas_attention.py`
+all accept it, so the same model runs dense, sequence-parallel, or on
+the flash kernel by swapping `attention_fn`).
+
+Shapes: int32 ids (B, T) -> logits (B, T, vocab). Training uses
+`lm_loss` (next-token shift, padding-aware). The decoder block IS the
+encoder block with a causal attention_fn — post-LN, like GPT-1; the
+blocks reuse `models/transformer.py` wholesale, so TP's MEGATRON_RULES
+and the pipeline stage splitter apply to the block stack unchanged.
+(The classification engines' train loops expect (B, C) logits + integer
+labels; LM training drives this model with `lm_loss` under plain
+jit/grad — see tests/test_gpt.py for the data-parallel recipe.)
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+from typing import List, Optional
+
+import jax
+import jax.numpy as jnp
+
+from distributed_model_parallel_tpu.models import layers as L
+from distributed_model_parallel_tpu.models.transformer import (
+    AttentionFn,
+    encoder_layer,
+)
+from distributed_model_parallel_tpu.ops.attention import (
+    dot_product_attention,
+)
+from distributed_model_parallel_tpu.training.metrics import cross_entropy
+
+
+@dataclasses.dataclass(frozen=True)
+class GPTConfig:
+    vocab_size: int = 50257
+    dim: int = 768
+    num_layers: int = 12
+    num_heads: int = 12
+    ffn_dim: int = 3072
+    max_position: int = 1024
+    dropout_rate: float = 0.1
+    # id treated as padding in the ATTENTION mask; None = every position
+    # is real (fixed-length LM batches). Loss exclusion is separate:
+    # use `lm_loss_fn(cfg)` (or pass pad_token_id to `lm_loss`) so pad
+    # targets are masked there too.
+    pad_token_id: Optional[int] = None
+
+
+def _lm_stem(cfg: GPTConfig) -> L.Layer:
+    """token + position embeddings, dropout. Output (hidden, mask)."""
+    drop = L.dropout(cfg.dropout_rate)
+
+    def init(key):
+        kw, kp = jax.random.split(key)
+        return {
+            "word": 0.02 * jax.random.normal(
+                kw, (cfg.vocab_size, cfg.dim)
+            ),
+            "position": 0.02 * jax.random.normal(
+                kp, (cfg.max_position, cfg.dim)
+            ),
+        }, {}
+
+    def apply(params, state, ids, ctx):
+        t = ids.shape[1]
+        mask = (
+            jnp.ones(ids.shape, jnp.bool_) if cfg.pad_token_id is None
+            else ids != cfg.pad_token_id
+        )
+        h = (
+            jnp.take(params["word"], ids, axis=0)
+            + params["position"][None, :t, :]
+        )
+        if ctx.dtype is not None:
+            h = h.astype(ctx.dtype)
+        h, _ = drop.apply({}, {}, h, ctx)
+        return (h, mask), state
+
+    return L.Layer(init, apply)
+
+
+def _lm_head(cfg: GPTConfig) -> L.Layer:
+    """Untied projection to the vocabulary; logits in f32."""
+
+    def init(key):
+        return {
+            "w": 0.02 * jax.random.normal(key, (cfg.dim, cfg.vocab_size))
+        }, {}
+
+    def apply(params, state, x, ctx):
+        h, _ = x
+        return h.astype(jnp.float32) @ params["w"], state
+
+    return L.Layer(init, apply)
+
+
+def decoder_blocks(
+    cfg: GPTConfig, attention_fn: Optional[AttentionFn] = None
+) -> List[L.Layer]:
+    attn = attention_fn or partial(dot_product_attention, causal=True)
+    return [
+        encoder_layer(
+            cfg.dim, cfg.num_heads, cfg.ffn_dim,
+            dropout_rate=cfg.dropout_rate, eps=1e-5, attention_fn=attn,
+        )
+        for _ in range(cfg.num_layers)
+    ]
+
+
+def gpt_lm(
+    cfg: GPTConfig, *, attention_fn: Optional[AttentionFn] = None
+) -> L.Layer:
+    """Full LM: ids (B, T) -> logits (B, T, vocab).
+
+    Pass `attention_fn=partial(flash_attention, causal=True)` for the
+    Pallas kernel. For sequence parallelism, shard the BLOCK stack
+    (`decoder_blocks` with `partial(ring_attention, axis_name='seq',
+    causal=True)`) under shard_map — the stem must stay unsharded (or
+    shard-aware): it indexes position embeddings with LOCAL offsets, so
+    running the full model seq-sharded would give shards 1..N-1 wrong
+    positions (see tests/test_gpt.py for the working recipe; a fully
+    seq-sharded stem needs the SequenceParallelEngine position-offset
+    treatment)."""
+    return L.named([
+        ("stem", _lm_stem(cfg)),
+        ("blocks", L.sequential(*decoder_blocks(cfg, attention_fn))),
+        ("head", _lm_head(cfg)),
+    ])
+
+
+def lm_loss_fn(cfg: GPTConfig):
+    """`lm_loss` bound to the config's pad_token_id — use this instead
+    of raw `lm_loss` so loss masking can't silently fall out of sync
+    with the attention mask."""
+    return partial(lm_loss, pad_token_id=cfg.pad_token_id)
+
+
+def lm_loss(logits: jax.Array, ids: jax.Array,
+            pad_token_id: Optional[int] = None) -> jax.Array:
+    """Next-token cross-entropy: position t predicts ids[t+1]; padding
+    targets (== pad_token_id) are excluded via the label -1 convention
+    `training/metrics.cross_entropy` already masks."""
+    targets = ids[:, 1:]
+    if pad_token_id is not None:
+        targets = jnp.where(targets == pad_token_id, -1, targets)
+    logits = logits[:, :-1, :]
+    b, t, v = logits.shape
+    return cross_entropy(
+        logits.reshape(b * t, v), targets.reshape(b * t)
+    )

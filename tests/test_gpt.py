@@ -1,0 +1,178 @@
+"""Causal LM (GPT-style decoder) tests, including the data-parallel
+training recipe and the flash/ring attention_fn swaps."""
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from distributed_model_parallel_tpu.models import layers as L
+from distributed_model_parallel_tpu.models.gpt import (
+    GPTConfig,
+    gpt_lm,
+    lm_loss,
+)
+from distributed_model_parallel_tpu.runtime.mesh import MeshSpec, make_mesh
+
+TINY = GPTConfig(
+    vocab_size=61, dim=32, num_layers=2, num_heads=4, ffn_dim=64,
+    max_position=32, dropout_rate=0.0,
+)
+B, T = 8, 16
+
+
+def _ids(seed=0):
+    rng = np.random.RandomState(seed)
+    return rng.randint(1, TINY.vocab_size, size=(B, T)).astype(np.int32)
+
+
+def test_shapes_and_causality():
+    model = gpt_lm(TINY)
+    params, state = model.init(jax.random.PRNGKey(0))
+    ids = jnp.asarray(_ids())
+    logits, _ = model.apply(params, state, ids, L.Context(train=False))
+    assert logits.shape == (B, T, TINY.vocab_size)
+    assert logits.dtype == jnp.float32
+    # Causality: editing a FUTURE token must not change past logits.
+    ids2 = ids.at[:, -1].set((ids[:, -1] % (TINY.vocab_size - 1)) + 1)
+    logits2, _ = model.apply(params, state, ids2, L.Context(train=False))
+    np.testing.assert_allclose(
+        np.asarray(logits[:, :-1]), np.asarray(logits2[:, :-1]),
+        rtol=1e-6,
+    )
+    assert not np.allclose(
+        np.asarray(logits[:, -1]), np.asarray(logits2[:, -1])
+    )
+
+
+def test_lm_loss_shift_and_padding():
+    cfg = GPTConfig(**{**TINY.__dict__, "pad_token_id": 0})
+    model = gpt_lm(cfg)
+    params, state = model.init(jax.random.PRNGKey(0))
+    ids = _ids()
+    ids[:, -4:] = 0  # pad tail
+    logits, _ = model.apply(
+        params, state, jnp.asarray(ids), L.Context(train=False)
+    )
+    loss = lm_loss(logits, jnp.asarray(ids), pad_token_id=0)
+    assert np.isfinite(float(loss))
+    # Fully padded targets -> loss ignores them: perturbing logits at
+    # padded target positions must not change the loss.
+    logits_pad = logits.at[:, -4:, :].add(100.0)
+    loss2 = lm_loss(logits_pad, jnp.asarray(ids), pad_token_id=0)
+    # positions -4..-2 predict padded targets; -5 predicts the first pad
+    np.testing.assert_allclose(float(loss), float(loss2), rtol=1e-6)
+
+
+def test_data_parallel_lm_training_learns():
+    """The LM training recipe: batch sharded over 'data' under plain
+    jit, grads derived by the partitioner — memorize a fixed corpus."""
+    mesh = make_mesh(MeshSpec(data=8))
+    repl = NamedSharding(mesh, P())
+    bsh = NamedSharding(mesh, P(("data",)))
+    model = gpt_lm(TINY)
+    params, state = model.init(jax.random.PRNGKey(0))
+    ids = jax.device_put(jnp.asarray(_ids(seed=4)), bsh)
+    params = jax.device_put(params, repl)
+
+    @partial(jax.jit, in_shardings=(repl, bsh), out_shardings=(repl, None),
+             donate_argnums=(0,))
+    def step(params, ids):
+        def loss_fn(p):
+            logits, _ = model.apply(p, state, ids, L.Context(train=True))
+            return lm_loss(logits, ids)
+
+        loss, grads = jax.value_and_grad(loss_fn)(params)
+        params2 = jax.tree_util.tree_map(
+            lambda p, g: p - 0.5 * g, params, grads
+        )
+        return params2, loss
+
+    losses = []
+    for _ in range(25):
+        params, loss = step(params, ids)
+        losses.append(float(loss))
+    assert losses[-1] < losses[0] * 0.5, losses[::6]
+
+
+@pytest.mark.parametrize("kind", ["flash", "ring"])
+def test_attention_fn_swaps_match_dense(kind):
+    """The same LM runs on the Pallas flash kernel or sequence-parallel
+    ring attention with identical logits."""
+    model_dense = gpt_lm(TINY)
+    params, state = model_dense.init(jax.random.PRNGKey(1))
+    ids = jnp.asarray(_ids(seed=2))
+    want, _ = model_dense.apply(params, state, ids, L.Context(train=False))
+
+    if kind == "flash":
+        from distributed_model_parallel_tpu.ops.pallas_attention import (
+            flash_attention,
+        )
+
+        model = gpt_lm(
+            TINY,
+            attention_fn=partial(
+                flash_attention, causal=True, block_q=8, block_k=8
+            ),
+        )
+        got, _ = model.apply(params, state, ids, L.Context(train=False))
+    else:
+        from jax import shard_map
+        from distributed_model_parallel_tpu.models.gpt import (
+            _lm_stem,
+            decoder_blocks,
+        )
+        from distributed_model_parallel_tpu.ops.ring_attention import (
+            ring_attention,
+        )
+
+        mesh = make_mesh(MeshSpec(data=2, seq=4))
+        ring_blocks = L.sequential(*decoder_blocks(
+            TINY, partial(ring_attention, axis_name="seq", causal=True)
+        ))
+        bstate = {str(i): {} for i in range(TINY.num_layers)}
+
+        # Stem/head are per-token; only attention crosses tokens, so the
+        # block stack + head run seq-sharded. (Position offsets in a
+        # seq-sharded STEM are the SequenceParallelEngine's job; here the
+        # dense stem runs first and its output is sharded.)
+        @jax.jit
+        @partial(
+            shard_map, mesh=mesh,
+            in_specs=(P(), (P(None, ("seq",)), P(None, ("seq",)))),
+            out_specs=P(None, ("seq",)),
+            check_vma=False,
+        )
+        def blocks_sp(p, x):
+            (h, _), _ = ring_blocks.apply(
+                p["blocks"], bstate, x, L.Context()
+            )
+            return h.astype(jnp.float32) @ p["head"]["w"]
+
+        (hh, mm), _ = _lm_stem(TINY).apply(
+            params["stem"], {}, ids, L.Context(train=False)
+        )
+        got = blocks_sp(params, (hh, mm))
+
+    np.testing.assert_allclose(
+        np.asarray(got), np.asarray(want), rtol=1e-4, atol=1e-4
+    )
+
+
+def test_lm_loss_fn_binds_pad_id():
+    from distributed_model_parallel_tpu.models.gpt import lm_loss_fn
+
+    cfg = GPTConfig(**{**TINY.__dict__, "pad_token_id": 0})
+    model = gpt_lm(cfg)
+    params, state = model.init(jax.random.PRNGKey(0))
+    ids = _ids()
+    ids[:, -4:] = 0
+    logits, _ = model.apply(
+        params, state, jnp.asarray(ids), L.Context(train=False)
+    )
+    bound = lm_loss_fn(cfg)(logits, jnp.asarray(ids))
+    explicit = lm_loss(logits, jnp.asarray(ids), pad_token_id=0)
+    np.testing.assert_allclose(float(bound), float(explicit))
