@@ -1,0 +1,125 @@
+"""Disk-backed dataset paths: CIFAR-10 binary batches, lazy ImageFolder
+trees, and the CUB200 metadata join — exercised against tiny synthetic
+fixtures written to tmp (no downloads, per SURVEY.md §4)."""
+
+import pickle
+
+import numpy as np
+import pytest
+from PIL import Image
+
+from distributed_model_parallel_tpu.data.datasets import (
+    ArrayDataset,
+    DatasetCollection,
+    LazyImageFolder,
+    cifar10,
+    cub200,
+    image_folder,
+)
+from distributed_model_parallel_tpu.data.loader import Loader
+
+
+def _write_cifar(root, n_per_batch=20):
+    d = root / "cifar-10-batches-py"
+    d.mkdir(parents=True)
+    rng = np.random.RandomState(0)
+
+    def write(name, n):
+        entry = {
+            b"data": rng.randint(
+                0, 256, size=(n, 3 * 32 * 32)
+            ).astype(np.uint8),
+            b"labels": rng.randint(0, 10, size=(n,)).tolist(),
+        }
+        with open(d / name, "wb") as f:
+            pickle.dump(entry, f)
+
+    for i in range(1, 6):
+        write(f"data_batch_{i}", n_per_batch)
+    write("test_batch", n_per_batch // 2)
+
+
+def test_cifar10_reads_binary_batches(tmp_path):
+    _write_cifar(tmp_path)
+    train, val = cifar10(str(tmp_path), fallback_synthetic=False)
+    assert len(train) == 100 and len(val) == 10
+    assert train.images.shape == (100, 32, 32, 3)
+    assert train.images.dtype == np.uint8
+    assert train.num_classes == 10
+    # And the string-keyed factory takes the same path.
+    train2, _ = DatasetCollection("CIFAR10", str(tmp_path)).init()
+    np.testing.assert_array_equal(train.images, train2.images)
+
+
+def test_cifar10_missing_raises_without_fallback(tmp_path):
+    with pytest.raises(FileNotFoundError):
+        cifar10(str(tmp_path), fallback_synthetic=False)
+
+
+def _write_image_tree(root, split="train", classes=("cat", "dog"), n=3,
+                      size=8):
+    rng = np.random.RandomState(1)
+    for c in classes:
+        d = root / split / c
+        d.mkdir(parents=True)
+        for i in range(n):
+            arr = rng.randint(0, 256, size=(size, size, 3)).astype(np.uint8)
+            Image.fromarray(arr).save(d / f"{i}.png")
+        # Stray non-image files must be filtered, not decoded mid-epoch.
+        (d / ".DS_Store").write_bytes(b"junk")
+        (d / "checksums.txt").write_text("abc")
+
+
+def test_image_folder_lazy_gather_and_loader(tmp_path):
+    _write_image_tree(tmp_path, "train")
+    _write_image_tree(tmp_path, "val", n=2)
+    train, val = image_folder(str(tmp_path), image_size=8)
+    assert isinstance(train, LazyImageFolder)
+    assert len(train) == 6 and len(val) == 4
+    assert train.num_classes == 2
+    images, labels = train.gather(np.array([0, 5]))
+    assert images.shape == (2, 8, 8, 3) and images.dtype == np.uint8
+    assert labels.tolist() == [0, 1]
+    # The Loader drives the lazy dataset through gather() end-to-end.
+    loader = Loader(train, batch_size=3, shuffle=True, seed=0)
+    batches = list(loader)
+    assert len(batches) == 2
+    assert batches[0][0].shape == (3, 8, 8, 3)
+
+
+def test_image_folder_eager_mode(tmp_path):
+    _write_image_tree(tmp_path, "train")
+    _write_image_tree(tmp_path, "val", n=1)
+    train, _ = image_folder(str(tmp_path), image_size=8, lazy=False)
+    assert isinstance(train, ArrayDataset)
+    assert train.images.shape == (6, 8, 8, 3)
+
+
+def test_cub200_metadata_join(tmp_path):
+    """The images.txt / train_test_split.txt / image_class_labels.txt
+    three-way join (`dataset_collection.py:8-27` does it with pandas;
+    ours is plain dicts)."""
+    rng = np.random.RandomState(2)
+    (tmp_path / "images" / "001.Black_footed_Albatross").mkdir(parents=True)
+    (tmp_path / "images" / "002.Laysan_Albatross").mkdir(parents=True)
+    rows = []
+    for i in range(1, 7):
+        cls = 1 if i <= 3 else 2
+        cdir = ("001.Black_footed_Albatross" if cls == 1
+                else "002.Laysan_Albatross")
+        rel = f"{cdir}/img_{i}.jpg"
+        arr = rng.randint(0, 256, size=(10, 12, 3)).astype(np.uint8)
+        Image.fromarray(arr).save(tmp_path / "images" / rel)
+        rows.append((i, rel, cls, 1 if i % 2 else 0))
+    with open(tmp_path / "images.txt", "w") as f:
+        f.writelines(f"{i} {rel}\n" for i, rel, _, _ in rows)
+    with open(tmp_path / "image_class_labels.txt", "w") as f:
+        f.writelines(f"{i} {cls}\n" for i, _, cls, _ in rows)
+    with open(tmp_path / "train_test_split.txt", "w") as f:
+        f.writelines(f"{i} {tr}\n" for i, _, _, tr in rows)
+
+    train, val = cub200(str(tmp_path), image_size=8)
+    assert len(train) == 3 and len(val) == 3
+    assert train.images.shape == (3, 8, 8, 3)
+    assert train.num_classes == 200
+    assert sorted(train.labels.tolist()) == [0, 0, 1]
