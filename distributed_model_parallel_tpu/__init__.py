@@ -7,19 +7,24 @@ parallel_apply / gather path of `torch.nn.DataParallel` and the bucketed
 DDP Reducer, re-expressed as XLA collectives over a named device mesh),
 pipeline model parallelism (the reference's autograd-transparent
 `dist.send/recv` stage transport, re-expressed as `lax.ppermute` under
-`shard_map` with static shapes), the model zoo (MobileNetV2 and variants,
-ResNet, BERT), the dataset collection, and the trainer surface (SGD +
-cosine decay + linear warmup, acc1/acc5 metrics, best-acc checkpointing
-with resume).
+`shard_map` with static shapes), tensor and sequence/context parallelism,
+the model zoo (MobileNetV2 and variants, ResNet, BERT, a GPT-style causal
+LM), the dataset collection, and the trainer surface (SGD + cosine decay
++ linear warmup, acc1/acc5 metrics, best-acc checkpointing with resume,
+elastic restarts). Mechanics: INTERNALS.md; numbers: RESULTS.md.
 
 Package layout:
   runtime/   mesh + multi-host bootstrap (replaces dist.init_process_group)
   models/    pure-functional model zoo (param/state pytrees, NHWC)
-  ops/       collectives, pipeline transport, attention (ring / Ulysses)
-  parallel/  DP / DDP / pipeline / tensor-parallel engines
-  data/      dataset collection + per-host sharded input pipeline
-  training/  trainer loops, optimizer/schedule, metrics, checkpointing
-  native/    C++ runtime components (data pipeline hot loop)
+  ops/       attention cores: XLA, ring / Ulysses sequence-parallel,
+             Pallas flash kernel
+  parallel/  DP / DDP / pipeline / tensor-parallel / sequence-parallel
+             engines
+  data/      dataset collection + per-host sharded, prefetching input
+             pipeline
+  training/  trainer loops, optimizer/schedule, metrics, checkpointing,
+             elastic restart driver
+  native/    C++ runtime components (input-pipeline hot loop)
 """
 
 __version__ = "0.1.0"
