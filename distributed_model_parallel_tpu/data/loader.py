@@ -165,7 +165,8 @@ class Loader:
         )
         if self.augment:
             ys, xs, flips = _draw_augment(aug_rng, len(images), 4)
-            if use_native and self.mean is not None:
+            if (use_native and self.mean is not None
+                    and images.dtype == np.uint8):
                 images = native.augment_normalize(
                     images, ys, xs, flips, 4, self.mean, self.std,
                     workers=self.workers,

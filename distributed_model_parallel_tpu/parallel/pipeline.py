@@ -32,11 +32,13 @@ Combinable with data parallelism: a (data=D, stage=S) mesh runs D
 independent pipelines, gradients pmean over 'data' and psum over 'stage'
 in the same fused reduction.
 
-Design notes / v1 tradeoffs:
-* Stage parameters are replicated across the mesh; each device *computes*
-  only its own stage (switch branch) but *stores* all stages. For the
-  reference-scale models (MobileNetV2 ~2.3M params) this is noise; sharding
-  param storage per stage is future work.
+Design notes:
+* Stage parameter STORAGE is a mode: the default replicates the per-stage
+  tuple on every device (each device *computes* only its own stage via
+  the switch branch — fine at reference scale, MobileNetV2 ~2.3M params);
+  `stage_local_params=True` stores params/momentum/BN state as (S, maxP)
+  arrays sharded over 'stage' so each device holds ~1/S of the model —
+  the memory scaling that makes pipeline MP a memory tool.
 * Activations cross stages in one flat buffer padded to the largest
   inter-stage tensor, so every ppermute has one static shape. The buffer
   dtype is the common type of all stage-I/O leaves (bf16 under mixed
@@ -107,6 +109,19 @@ def _pack(tree, buf_size: int, dtype=jnp.float32) -> jax.Array:
         return jnp.zeros((buf_size,), dtype)
     flat = jnp.concatenate(flats) if len(flats) > 1 else flats[0]
     return jnp.zeros((buf_size,), dtype).at[: flat.shape[0]].set(flat)
+
+
+def _to_host(x):
+    """Global array -> host numpy, multi-host safe: a 'stage'-sharded
+    array's rows may live on OTHER hosts (non-fully-addressable), where
+    plain device_get raises — allgather across processes instead."""
+    import numpy as np
+
+    if isinstance(x, jax.Array) and not x.is_fully_addressable:
+        from jax.experimental import multihost_utils
+
+        return np.asarray(multihost_utils.process_allgather(x, tiled=True))
+    return np.asarray(jax.device_get(x))
 
 
 def _pack_np(tree, buf_size: int):
@@ -255,7 +270,7 @@ class PipelineEngine:
         transplant, and tests."""
         if not self.stage_local_params:
             return ts.params
-        flat = jax.device_get(ts.params)
+        flat = _to_host(ts.params)
         return tuple(
             _unpack(flat[i], self._param_avals[i])
             for i in range(self.num_stages)
@@ -271,12 +286,12 @@ class PipelineEngine:
         on restore, which a packed (S, maxP) leaf cannot)."""
         if not self.stage_local_params:
             return ts
-        flat_m = jax.device_get(ts.opt_state.momentum)
+        flat_m = _to_host(ts.opt_state.momentum)
         momentum = tuple(
             _unpack(flat_m[i], self._param_avals[i])
             for i in range(self.num_stages)
         )
-        flat_s = jax.device_get(ts.model_state)
+        flat_s = _to_host(ts.model_state)
         state = tuple(
             _unpack(flat_s[i], self._state_avals[i])
             for i in range(self.num_stages)

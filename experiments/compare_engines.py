@@ -110,6 +110,7 @@ def main() -> None:
     if os.path.exists(args.out):
         with open(args.out) as f:
             results = json.load(f).get("results", {})
+    meta = None  # set per engine run; guards the no-engine-matched case
     for name, engine in engines():
         if wanted is not None and name not in wanted:
             continue
@@ -157,6 +158,11 @@ def main() -> None:
         os.makedirs(os.path.dirname(args.out) or ".", exist_ok=True)
         with open(args.out, "w") as f:  # incremental: survive timeouts
             json.dump({"meta": meta, "results": results}, f, indent=2)
+
+    if meta is None:
+        raise SystemExit(
+            f"--engines {args.engines!r} matched nothing; nothing ran"
+        )
 
     # ---- figures (the reference's loss/acc overlay, pic/*.png) --------
     os.makedirs(args.pic_dir, exist_ok=True)

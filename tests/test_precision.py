@@ -147,3 +147,24 @@ def test_profiler_trace_captured(tmp_path):
     assert any(f.is_file() for f in trace_files), (
         "profile_dir produced no trace files"
     )
+
+
+def test_pipeline_bf16_stage_local_combo():
+    """The two pipeline options compose: bf16 activations/wire WITH
+    stage-local (1/S-sharded) parameter storage."""
+    mesh = make_mesh(MeshSpec(data=2, stage=4))
+    stages = tinycnn.split_stages(4, 10)
+    f32 = PipelineEngine(
+        stages, SGD(), mesh, num_microbatches=2, donate=False,
+        stage_local_params=True,
+    )
+    bf16 = PipelineEngine(
+        stages, SGD(), mesh, num_microbatches=2, donate=False,
+        stage_local_params=True, compute_dtype=jnp.bfloat16,
+    )
+    _, losses_f32 = _run_steps(f32)
+    ts_bf16, losses_bf16 = _run_steps(bf16)
+    np.testing.assert_allclose(losses_bf16, losses_f32, rtol=8e-2)
+    # storage stays f32 master rows, sharded 1/S
+    assert ts_bf16.params.dtype == jnp.float32
+    assert {s.data.shape[0] for s in ts_bf16.params.addressable_shards} == {1}
