@@ -54,10 +54,10 @@ STAGE_BUILDERS = {
 }
 
 
-def build_model(name: str, num_classes: int):
+def build_model(name: str, num_classes: int, *, remat: bool = False):
     if name not in MODELS:
         raise SystemExit(f"unknown model {name!r}; choose from {sorted(MODELS)}")
-    return MODELS[name](num_classes)
+    return MODELS[name](num_classes, remat=remat)
 
 
 def stats_for(dataset_type: str) -> Tuple[np.ndarray, np.ndarray]:
@@ -159,6 +159,11 @@ def add_common_tpu_flags(parser: argparse.ArgumentParser) -> None:
         "--dtype", default="float32", choices=("float32", "bfloat16"),
         help="activation/compute dtype (params stay f32); bfloat16 is the "
              "TPU MXU's native matmul precision",
+    )
+    parser.add_argument(
+        "--remat", action="store_true",
+        help="rematerialize activations during backward (jax.checkpoint) "
+             "— trades compute for HBM on deep models",
     )
     parser.add_argument(
         "--profile-dir", default=None,

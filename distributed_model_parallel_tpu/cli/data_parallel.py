@@ -112,7 +112,7 @@ def main(argv=None) -> dict:
         val_batch_size=args.val_batch_size,
         workers=args.workers,
     )
-    model = build_model(args.model, num_classes)
+    model = build_model(args.model, num_classes, remat=args.remat)
     opt = SGD(momentum=args.momentum, weight_decay=args.weight_decay)
     cdt = compute_dtype_from_flag(args.dtype)
     if args.engine == "ddp":

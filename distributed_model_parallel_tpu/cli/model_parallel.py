@@ -121,6 +121,7 @@ def main(argv=None) -> dict:
         num_microbatches=args.microbatches,
         compute_dtype=compute_dtype_from_flag(args.dtype),
         stage_local_params=args.stage_local_params,
+        remat=args.remat,
     )
     cfg = TrainerConfig(
         epochs=args.epochs,

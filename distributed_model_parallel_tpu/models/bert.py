@@ -160,11 +160,16 @@ def bert_for_classification(
     cfg: BertConfig = BERT_BASE,
     *,
     attention_fn: AttentionFn = dot_product_attention,
+    remat: bool = False,
 ) -> L.Layer:
-    """Full classification model: int ids (B, T) -> logits (B, C)."""
+    """Full classification model: int ids (B, T) -> logits (B, C).
+    `remat=True` checkpoints each encoder layer."""
+    blocks = _encoder_blocks(cfg, attention_fn)
+    if remat:
+        blocks = [L.remat(b) for b in blocks]
     return L.named([
         ("stem", _embeddings(cfg)),
-        ("blocks", L.sequential(*_encoder_blocks(cfg, attention_fn))),
+        ("blocks", L.sequential(*blocks)),
         ("head", _cls_head(cfg, num_classes)),
     ])
 

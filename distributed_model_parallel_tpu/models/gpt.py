@@ -115,7 +115,8 @@ def decoder_blocks(
 
 
 def gpt_lm(
-    cfg: GPTConfig, *, attention_fn: Optional[AttentionFn] = None
+    cfg: GPTConfig, *, attention_fn: Optional[AttentionFn] = None,
+    remat: bool = False,
 ) -> L.Layer:
     """Full LM: ids (B, T) -> logits (B, T, vocab).
 
@@ -128,9 +129,12 @@ def gpt_lm(
     positions (see tests/test_gpt.py for the working recipe; a fully
     seq-sharded stem needs the SequenceParallelEngine position-offset
     treatment)."""
+    blocks = decoder_blocks(cfg, attention_fn)
+    if remat:
+        blocks = [L.remat(b) for b in blocks]
     return L.named([
         ("stem", _lm_stem(cfg)),
-        ("blocks", L.sequential(*decoder_blocks(cfg, attention_fn))),
+        ("blocks", L.sequential(*blocks)),
         ("head", _lm_head(cfg)),
     ])
 

@@ -367,6 +367,37 @@ def named(pairs: Sequence[tuple[str, Layer]]) -> Layer:
     return Layer(init, apply)
 
 
+def remat(layer: Layer, *, policy=None) -> Layer:
+    """Gradient rematerialization (`jax.checkpoint`): activations inside
+    `layer` are recomputed during the backward pass instead of stored —
+    the standard TPU trade of MXU FLOPs for HBM when deep stacks don't
+    fit. Engines expose this as `remat=True` (wrapping the whole model /
+    each pipeline stage / each transformer block); `policy` passes
+    through to jax.checkpoint (e.g.
+    jax.checkpoint_policies.dots_with_no_batch_dims_saveable).
+
+    Static Context fields ride the closure; the rng (a traced array)
+    is threaded as a real argument so the checkpointed function stays
+    closure-clean for autodiff."""
+
+    def apply(params, state, x, ctx):
+        if ctx.rng is None:
+            fn = jax.checkpoint(
+                lambda p, s, xx: layer.apply(p, s, xx, ctx),
+                policy=policy,
+            )
+            return fn(params, state, x)
+        fn = jax.checkpoint(
+            lambda p, s, xx, r: layer.apply(
+                p, s, xx, dataclasses.replace(ctx, rng=r)
+            ),
+            policy=policy,
+        )
+        return fn(params, state, x, ctx.rng)
+
+    return Layer(layer.init, apply)
+
+
 def residual(body: Layer, shortcut: Optional[Layer] = None) -> Layer:
     """out = body(x) + shortcut(x); shortcut=None means identity."""
 

@@ -100,18 +100,24 @@ def _head(num_classes: int, batchnorm: bool) -> L.Layer:
     ])
 
 
-def mobilenet_v2(num_classes: int = 10, *, batchnorm: bool = True) -> L.Layer:
+def mobilenet_v2(num_classes: int = 10, *, batchnorm: bool = True,
+                 remat: bool = False) -> L.Layer:
     """Full network (`MobileNetV2`, `mobilenetv2.py:39-77`; set
-    `batchnorm=False` for `MobileNetV2_nobn`, `:111-148`)."""
+    `batchnorm=False` for `MobileNetV2_nobn`, `:111-148`). `remat=True`
+    checkpoints each inverted-residual block (per-block granularity is
+    what actually lowers peak activation HBM)."""
+    blocks = _make_blocks(batchnorm=batchnorm)
+    if remat:
+        blocks = [L.remat(b) for b in blocks]
     return L.named([
         ("stem", _stem(batchnorm)),
-        ("blocks", L.sequential(*_make_blocks(batchnorm=batchnorm))),
+        ("blocks", L.sequential(*blocks)),
         ("head", _head(num_classes, batchnorm)),
     ])
 
 
-def mobilenet_v2_nobn(num_classes: int = 10) -> L.Layer:
-    return mobilenet_v2(num_classes, batchnorm=False)
+def mobilenet_v2_nobn(num_classes: int = 10, *, remat: bool = False) -> L.Layer:
+    return mobilenet_v2(num_classes, batchnorm=False, remat=remat)
 
 
 def split_stages(num_stages: int, num_classes: int = 10, *,

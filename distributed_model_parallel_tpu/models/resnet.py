@@ -112,10 +112,13 @@ def _head(feat: int, num_classes: int) -> L.Layer:
     ])
 
 
-def resnet(depth: int, num_classes: int = 1000, *, cifar: bool = False) -> L.Layer:
+def resnet(depth: int, num_classes: int = 1000, *, cifar: bool = False,
+           remat: bool = False) -> L.Layer:
     """Build ResNet-{18,34,50,101,152}. `cifar=True` swaps in the 3x3
     stride-1 stem with no maxpool (the standard CIFAR adaptation)."""
     blocks, feat = _make_blocks(depth)
+    if remat:
+        blocks = [L.remat(b) for b in blocks]
     return L.named([
         ("stem", _stem(cifar)),
         ("blocks", L.sequential(*blocks)),
@@ -123,14 +126,16 @@ def resnet(depth: int, num_classes: int = 1000, *, cifar: bool = False) -> L.Lay
     ])
 
 
-def resnet18(num_classes: int = 10, *, cifar: bool = True) -> L.Layer:
+def resnet18(num_classes: int = 10, *, cifar: bool = True,
+             remat: bool = False) -> L.Layer:
     """The 'ResNet-18 CIFAR-10 single-process' BASELINE config."""
-    return resnet(18, num_classes, cifar=cifar)
+    return resnet(18, num_classes, cifar=cifar, remat=remat)
 
 
-def resnet50(num_classes: int = 1000, *, cifar: bool = False) -> L.Layer:
+def resnet50(num_classes: int = 1000, *, cifar: bool = False,
+             remat: bool = False) -> L.Layer:
     """The north-star benchmark model (images/sec/chip)."""
-    return resnet(50, num_classes, cifar=cifar)
+    return resnet(50, num_classes, cifar=cifar, remat=remat)
 
 
 def split_stages(depth: int, num_stages: int, num_classes: int = 1000, *,

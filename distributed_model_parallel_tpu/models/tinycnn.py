@@ -41,10 +41,13 @@ def _head(num_classes: int) -> L.Layer:
     return L.sequential(L.global_avg_pool(), L.linear(WIDTH, num_classes))
 
 
-def tiny_cnn(num_classes: int = 10) -> L.Layer:
+def tiny_cnn(num_classes: int = 10, *, remat: bool = False) -> L.Layer:
+    blocks = [_block(i) for i in range(N_BLOCKS)]
+    if remat:
+        blocks = [L.remat(b) for b in blocks]
     return L.named([
         ("stem", _stem()),
-        ("blocks", L.sequential(*[_block(i) for i in range(N_BLOCKS)])),
+        ("blocks", L.sequential(*blocks)),
         ("head", _head(num_classes)),
     ])
 

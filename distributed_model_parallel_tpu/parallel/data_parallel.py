@@ -111,12 +111,17 @@ class DataParallelEngine:
     # — the TPU MXU's native matmul dtype), params/optimizer/loss in f32.
     # None keeps the input dtype (f32 path).
     compute_dtype: Any = None
+    # NOTE: rematerialization lives at MODEL construction (per-block
+    # `remat=True` on the model builders / `layers.remat`): a whole-model
+    # checkpoint would re-live every residual at the start of backprop
+    # and save no peak HBM.
 
     def __post_init__(self):
         mesh = self.mesh
         self._repl = NamedSharding(mesh, P())
         self._batch = NamedSharding(mesh, P(("data",)))
         cdt = self.compute_dtype
+        model = self.model
 
         def train_step(ts: TrainState, images, labels, lr):
             # Deterministic per-step dropout key (global batch => one key;
@@ -125,7 +130,7 @@ class DataParallelEngine:
             images_c = _cast_input(images, cdt)
 
             def loss_fn(params, model_state):
-                logits, new_state = self.model.apply(
+                logits, new_state = model.apply(
                     params, model_state, images_c,
                     Context(train=True, rng=rng, dtype=cdt),
                 )
@@ -142,7 +147,7 @@ class DataParallelEngine:
             return new_ts, _metrics(loss, logits, labels)
 
         def eval_step(ts: TrainState, images, labels):
-            logits, _ = self.model.apply(
+            logits, _ = self.model.apply(  # eval: no backward, no remat
                 ts.params, ts.model_state, _cast_input(images, cdt),
                 Context(train=False, dtype=cdt),
             )
@@ -205,6 +210,7 @@ class DDPEngine:
         self._batch = NamedSharding(mesh, P(("data",)))
         bn_axis = "data" if self.sync_bn else None
         cdt = self.compute_dtype
+        model = self.model
 
         @partial(
             shard_map,
@@ -225,7 +231,7 @@ class DDPEngine:
             images_c = _cast_input(images, cdt)
 
             def loss_fn(params, model_state):
-                logits, new_state = self.model.apply(
+                logits, new_state = model.apply(
                     params, model_state, images_c,
                     Context(train=True, bn_axis=bn_axis, rng=rng, dtype=cdt),
                 )

@@ -63,6 +63,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from jax import shard_map
 
 from distributed_model_parallel_tpu.models.layers import Context, Layer
+from distributed_model_parallel_tpu.models.layers import remat as remat_layer
 from distributed_model_parallel_tpu.parallel.data_parallel import (
     TrainState,
     _cast_input,
@@ -170,6 +171,8 @@ class PipelineEngine:
     sync_bn: bool = False
     donate: bool = True
     compute_dtype: Any = None  # mixed precision; see DataParallelEngine
+    # Rematerialize each stage's forward during backward (jax.checkpoint).
+    remat: bool = False
     # Stage-local parameter storage: params / BN state / momentum live as
     # (S, maxP) f32 arrays sharded over 'stage', so each device STORES
     # ~1/S of the model instead of all of it — the memory scaling that is
@@ -351,6 +354,10 @@ class PipelineEngine:
         bn_axis = "data" if self.sync_bn else None
         cdt = self.compute_dtype
         local = self.stage_local_params
+        exec_stages = (
+            [remat_layer(s) for s in self.stages] if self.remat
+            else self.stages
+        )
 
         def stage_params(params, i):
             """Stage i's param pytree from either representation. In
@@ -403,7 +410,7 @@ class PipelineEngine:
                         x = images_mb
                     else:
                         x = _unpack(buf, in_aval)
-                    y, new_si = self.stages[i].apply(
+                    y, new_si = exec_stages[i].apply(
                         stage_params(params, i), stage_state(state, i),
                         x, ctx,
                     )

@@ -77,6 +77,8 @@ class SequenceParallelEngine:
     attention: str = "ring"
     donate: bool = True
     compute_dtype: Any = None
+    # Rematerialize each transformer block during backward (jax.checkpoint).
+    remat: bool = False
 
     def __post_init__(self):
         mesh = self.mesh
@@ -92,7 +94,10 @@ class SequenceParallelEngine:
         self._repl = NamedSharding(mesh, P())
         self._batch = NamedSharding(mesh, P(("data",), ("seq",)))
         self._labels = NamedSharding(mesh, P(("data",)))
-        self._blocks = L.sequential(*_encoder_blocks(cfg, attn_fn))
+        block_list = _encoder_blocks(cfg, attn_fn)
+        if self.remat:
+            block_list = [L.remat(b) for b in block_list]
+        self._blocks = L.sequential(*block_list)
         self._full = L.named([
             ("stem", _embeddings(cfg)),
             ("blocks", self._blocks),

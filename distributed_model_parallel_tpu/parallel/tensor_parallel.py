@@ -88,6 +88,7 @@ class TensorParallelEngine:
     rules: Sequence[Tuple[str, P]] = MEGATRON_RULES
     donate: bool = True
     compute_dtype: Any = None  # see DataParallelEngine
+    # (remat lives at model construction — see DataParallelEngine note)
 
     def __post_init__(self):
         mesh = self.mesh
@@ -96,13 +97,14 @@ class TensorParallelEngine:
         self._repl = NamedSharding(mesh, P())
         self._batch = NamedSharding(mesh, P(("data",)))
         cdt = self.compute_dtype
+        model = self.model
 
         def train_step(ts: TrainState, inputs, labels, lr):
             rng = jax.random.fold_in(jax.random.PRNGKey(0), ts.step)
             inputs_c = _cast_input(inputs, cdt)
 
             def loss_fn(params, model_state):
-                logits, new_state = self.model.apply(
+                logits, new_state = model.apply(
                     params, model_state, inputs_c,
                     Context(train=True, rng=rng, dtype=cdt),
                 )
