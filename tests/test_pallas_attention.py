@@ -64,23 +64,91 @@ def test_bf16_output_dtype():
     )
 
 
-def test_gradients_match_reference():
-    """custom_vjp backward (XLA recompute) gives exact reference grads."""
-    q, k, v, mask = _qkv(seed=5, t=64)
-
+def _grad_check(q, k, v, mask, causal=False, rtol=2e-4, atol=2e-5, **kw):
     def loss_flash(q, k, v):
-        return jnp.sum(jnp.square(flash_attention(q, k, v, mask)))
+        return jnp.sum(
+            jnp.square(flash_attention(q, k, v, mask, causal=causal, **kw))
+        )
 
     def loss_ref(q, k, v):
-        return jnp.sum(jnp.square(dot_product_attention(q, k, v, mask)))
+        return jnp.sum(
+            jnp.square(dot_product_attention(q, k, v, mask, causal=causal))
+        )
 
     got = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
     want = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
     for g, w, name in zip(got, want, "qkv"):
         np.testing.assert_allclose(
-            np.asarray(g), np.asarray(w), rtol=2e-4, atol=2e-5,
-            err_msg=f"grad wrt {name}",
+            np.asarray(g, np.float32), np.asarray(w, np.float32),
+            rtol=rtol, atol=atol, err_msg=f"grad wrt {name}",
         )
+
+
+def test_gradients_match_reference():
+    """Fused Pallas backward (dq and dk/dv kernels) gives reference grads
+    with a key-validity mask."""
+    q, k, v, mask = _qkv(seed=5, t=64)
+    _grad_check(q, k, v, mask)
+
+
+def test_gradients_multiple_blocks():
+    """Backward accumulation across several q- and k-blocks."""
+    q, k, v, mask = _qkv(seed=9)
+    _grad_check(q, k, v, mask, block_q=64, block_k=64)
+
+
+def test_gradients_causal():
+    """Causal backward: the frontier predicate skips dead tiles in both
+    kernels without dropping live contributions."""
+    q, k, v, _ = _qkv(seed=10)
+    _grad_check(q, k, v, None, causal=True, block_q=64, block_k=64)
+
+
+def test_gradients_causal_with_mask():
+    """Causal frontier predicate composed with a key-validity mask, all
+    of dq/dk/dv — guards the interaction between _bwd_dkv_step's
+    frontier skip and _mask_window."""
+    q, k, v, mask = _qkv(seed=13)
+    _grad_check(q, k, v, mask, causal=True, block_q=64, block_k=64)
+
+
+def test_gradients_bf16():
+    q, k, v, _ = _qkv(seed=11, dtype=jnp.bfloat16, t=128)
+    got = jax.grad(
+        lambda q, k, v: jnp.sum(
+            flash_attention(q, k, v).astype(jnp.float32) ** 2
+        ),
+        argnums=(0, 1, 2),
+    )(q, k, v)
+    want = jax.grad(
+        lambda q, k, v: jnp.sum(
+            dot_product_attention(q, k, v).astype(jnp.float32) ** 2
+        ),
+        argnums=(0, 1, 2),
+    )(q, k, v)
+    for g, w, name in zip(got, want, "qkv"):
+        assert g.dtype == jnp.bfloat16
+        np.testing.assert_allclose(
+            np.asarray(g, np.float32), np.asarray(w, np.float32),
+            rtol=1e-1, atol=1e-1, err_msg=f"grad wrt {name}",
+        )
+
+
+def test_gradients_fully_masked_row():
+    """A batch row whose keys are ALL masked: forward outputs zeros and
+    the fused backward's +inf LSE sentinel produces zero gradients
+    instead of NaN."""
+    q, k, v, _ = _qkv(seed=12, t=64)
+    mask = jnp.ones((B, 64), bool).at[1, :].set(False)
+    out = flash_attention(q, k, v, mask)
+    np.testing.assert_array_equal(np.asarray(out[1]), 0.0)
+    grads = jax.grad(
+        lambda q, k, v: jnp.sum(jnp.square(flash_attention(q, k, v, mask))),
+        argnums=(0, 1, 2),
+    )(q, k, v)
+    for g in grads:
+        assert bool(jnp.all(jnp.isfinite(g)))
+        np.testing.assert_array_equal(np.asarray(g[1]), 0.0)
 
 
 def test_encoder_layer_with_flash_attention():
