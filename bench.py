@@ -93,6 +93,22 @@ def _fake_batch(batch: int, seed: int = 0, hw: int = 32):
     return images, labels
 
 
+def _sync(state) -> int:
+    """Force REAL completion of every queued step by fetching a value.
+
+    `jax.block_until_ready` is not a reliable barrier on this host's
+    tunneled TPU backend — it can return at dispatch time, which once
+    inflated this benchmark ~100x (a chained 8192^3 matmul 'measured'
+    34 PFLOP/s on one v5e; with a value fetch it measures 139 TFLOP/s,
+    i.e. 71% of the chip's 197 TF peak — see RESULTS.md). Fetching the
+    step counter's bytes cannot complete before the executable that
+    produces them has actually run, and it depends on the whole chain
+    of prior steps."""
+    import jax
+
+    return int(jax.device_get(state.step))
+
+
 def _aot_step(engine, state, images, labels, lr):
     """AOT-compile the train step ONCE and return (step_fn, flops).
 
@@ -161,14 +177,15 @@ def _measure(model_name: str, batch: int, dtype_name: str,
     step, flops = _aot_step(engine, state, images, labels, lr)
     for _ in range(warmup):
         state = step(state)
-    jax.block_until_ready(state)
+    _sync(state)
     log(f"compile+warmup took {time.perf_counter() - t0:.1f}s; measuring")
     # Adaptive iteration count: size the measurement window to ~3s so a
-    # ~2ms TPU step gets a stable average, not a 60ms-window noise sample.
+    # few-ms TPU step gets a stable average (and the one value-fetch
+    # roundtrip in _sync amortizes away), not a noise sample.
     t0 = time.perf_counter()
     for _ in range(iters):
         state = step(state)
-    jax.block_until_ready(state)
+    _sync(state)
     dt = time.perf_counter() - t0
     if dt < 1.0:
         sec0 = dt / iters
@@ -177,7 +194,7 @@ def _measure(model_name: str, batch: int, dtype_name: str,
         t0 = time.perf_counter()
         for _ in range(iters):
             state = step(state)
-        jax.block_until_ready(state)
+        _sync(state)
         dt = time.perf_counter() - t0
     return {
         "img_per_sec": batch * iters / dt,
@@ -320,12 +337,12 @@ def run_child_scaling(max_devices: int, model_name: str = "tinycnn",
         lr = jnp.float32(0.1)
         for _ in range(2):
             state, _ = engine.train_step(state, images, labels, lr)
-        jax.block_until_ready(state)
+        _sync(state)
         iters = 10
         t0 = time.perf_counter()
         for _ in range(iters):
             state, _ = engine.train_step(state, images, labels, lr)
-        jax.block_until_ready(state)
+        _sync(state)
         dt = time.perf_counter() - t0
         per_chip = batch * iters / dt / n
         rows.append({"chips": n, "img_per_sec_per_chip": round(per_chip, 1)})

@@ -15,9 +15,13 @@ Reproduces the reference's observable training behavior (SURVEY.md §5):
 * cosine LR (T_max=90) with 10-epoch linear-warmup dampening stepped once
   per epoch (`data_parallel.py:90-96,163-164`).
 
-Timing is `block_until_ready`-correct: JAX dispatch is async, so per-epoch
-averages are computed from a fenced epoch wall clock, not from unfenced
-per-step deltas (which would measure dispatch latency, not execution).
+Timing is fence-correct: JAX dispatch is async, so per-epoch averages are
+computed from a fenced epoch wall clock, not from unfenced per-step deltas
+(which would measure dispatch latency, not execution). The fence is a
+VALUE FETCH of the epoch's summed metrics, not `block_until_ready` —
+on a tunneled/remote TPU backend the latter can return at dispatch time
+(measured ~100x-optimistic; see bench.py `_sync`), while fetched bytes
+cannot exist before the steps that produced them ran.
 """
 
 from __future__ import annotations
@@ -213,7 +217,12 @@ class Trainer:
                     f"\tAcc@1 {100.0 * m['correct1'] / m['count']:.3f}"
                     f"\tTime {(time.perf_counter() - epoch_start) / n_batches:.3f}"
                 )
-        jax.block_until_ready(self.state)
+        # Value-fetch barrier: on a tunneled/remote backend
+        # block_until_ready can return at dispatch time (see
+        # bench._sync), but fetching the summed metrics' bytes cannot
+        # complete before every step that fed the sum has executed.
+        if sums is not None:
+            sums = jax.device_get(sums)
         if profiling:  # epoch ended inside the capture window
             jax.profiler.stop_trace()
             self._profiled = True
@@ -242,7 +251,7 @@ class Trainer:
             )
             n_batches += 1
         if sums is not None:
-            jax.block_until_ready(sums)
+            sums = jax.device_get(sums)  # value-fetch barrier, as above
         wall = time.perf_counter() - epoch_start
         return self._finalize(sums, n_batches, wall, data_time)
 

@@ -80,7 +80,7 @@ def test_sequence_parallel_remat_matches():
     )
 
     cfg = BertConfig(
-        vocab_size=67, hidden_size=32, num_layers=2, num_heads=4,
+        vocab_size=67, hidden_size=32, num_layers=1, num_heads=4,
         intermediate_size=64, max_position=16, dropout_rate=0.0,
     )
     mesh = make_mesh(MeshSpec(data=2, seq=4))

@@ -75,10 +75,19 @@ def test_megatron_rules_map_expected_paths():
 
 def test_tp_matches_replicated_trajectory():
     """(data=2, model=4) mesh == plain 8-way DP: the partitioner's
-    Megatron collectives are numerically invisible."""
+    Megatron collectives are numerically invisible.
+
+    One encoder layer: the Megatron rules are per-layer, so a second
+    layer only doubles the CPU-mesh compile time without adding
+    coverage (multi-layer stacking is exercised by the TINY-config
+    tests around this one)."""
     tp_mesh = make_mesh(MeshSpec(data=2, model=4))
     dp_mesh = make_mesh(MeshSpec(data=8))
-    model = bert_for_classification(CLASSES, TINY)
+    import dataclasses as _dc
+
+    model = bert_for_classification(
+        CLASSES, _dc.replace(TINY, num_layers=1)
+    )
     _, losses_tp = _run(
         TensorParallelEngine(model, SGD(), tp_mesh, donate=False)
     )

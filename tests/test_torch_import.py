@@ -182,9 +182,12 @@ def test_missing_keys_fail_loudly():
         mobilenetv2_from_torch_state_dict(params, state, sd)
 
 
+@pytest.mark.slow
 def test_cli_finetune_flag(tmp_path, monkeypatch):
     """End-to-end: --finetune loads a reference-format checkpoint into
-    the DP training entry point and trains from it."""
+    the DP training entry point and trains from it. Slow (full
+    MobileNetV2 train-step compile on the CPU mesh); the transplant
+    numerics and the head-swap logic have fast twins above."""
     sd = make_state_dict(num_classes=1000)  # ImageNet-style head
     np.savez(tmp_path / "pre.npz", **sd)
     monkeypatch.chdir(tmp_path)
