@@ -7,19 +7,20 @@ parallel_apply / gather path of `torch.nn.DataParallel` and the bucketed
 DDP Reducer, re-expressed as XLA collectives over a named device mesh),
 pipeline model parallelism (the reference's autograd-transparent
 `dist.send/recv` stage transport, re-expressed as `lax.ppermute` under
-`shard_map` with static shapes), tensor and sequence/context parallelism,
-the model zoo (MobileNetV2 and variants, ResNet, BERT, a GPT-style causal
-LM), the dataset collection, and the trainer surface (SGD + cosine decay
-+ linear warmup, acc1/acc5 metrics, best-acc checkpointing with resume,
-elastic restarts). Mechanics: INTERNALS.md; numbers: RESULTS.md.
+`shard_map` with static shapes), tensor, sequence/context, and expert
+(MoE) parallelism, the model zoo (MobileNetV2 and variants, ResNet,
+BERT, a GPT-style causal LM, MoE transformer blocks), the dataset
+collection, and the trainer surface (SGD + cosine decay + linear warmup,
+acc1/acc5 metrics, best-acc checkpointing with resume, elastic
+restarts). Mechanics: INTERNALS.md; numbers: RESULTS.md.
 
 Package layout:
   runtime/   mesh + multi-host bootstrap (replaces dist.init_process_group)
   models/    pure-functional model zoo (param/state pytrees, NHWC)
   ops/       attention cores: XLA, ring / Ulysses sequence-parallel,
              Pallas flash kernel
-  parallel/  DP / DDP / pipeline / tensor-parallel / sequence-parallel
-             engines
+  parallel/  DP / DDP / pipeline / tensor-parallel / sequence-parallel /
+             expert-parallel engines
   data/      dataset collection + per-host sharded, prefetching input
              pipeline
   training/  trainer loops, optimizer/schedule, metrics, checkpointing,

@@ -27,3 +27,7 @@ from distributed_model_parallel_tpu.models.gpt import (  # noqa: F401
     lm_loss,
     lm_loss_fn,
 )
+from distributed_model_parallel_tpu.models.moe import (  # noqa: F401
+    moe_encoder_layer,
+    moe_feed_forward,
+)

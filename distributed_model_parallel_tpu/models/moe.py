@@ -1,0 +1,220 @@
+"""Mixture-of-Experts feed-forward — the routed FFN behind expert
+parallelism (`parallel/expert_parallel.py`).
+
+Absent from the reference (SURVEY.md §2.3: "EP — absent, non-goal"); it
+exists here because the framework treats every parallelism axis as
+first-class. The design is the dense-dispatch GShard/Switch formulation,
+chosen FOR the TPU: routing is expressed as einsums against one-hot
+dispatch/combine tensors — static shapes, no gather/scatter, everything
+on the MXU — so under GSPMD the expert dimension shards over the
+`'expert'` mesh axis and the partitioner inserts the token all-to-alls
+that GPU MoE stacks hand-write.
+
+Mechanics per token (top-k routing with capacity):
+  * router logits -> softmax gates (f32), masked tokens zeroed;
+  * k rounds of argmax pick distinct experts; each round assigns the
+    token a position in that expert's buffer via a cumulative count,
+    tokens past the capacity C = ceil(top_k * T * capacity_factor / E)
+    are DROPPED (their combine weight is 0 — the residual stream
+    carries them unchanged, the standard Switch behavior);
+  * chosen gates renormalize over the kept experts;
+  * dispatch einsum packs (B, T, D) -> (E, B, C, D), the per-expert
+    FFN runs as batched matmuls over the leading E axis, and the
+    combine einsum scatters back weighted by the gates.
+
+The load-balance auxiliary loss (Switch eq. 4: E * Σ_e f_e · p_e,
+pre-scaled by `aux_loss_weight`) is returned through the layer STATE
+under the reserved key `"moe_aux"`; engines add every `moe_aux` leaf of
+the post-forward state to the training loss (see
+`parallel/data_parallel.py::aux_loss`), which keeps `Layer`'s
+(params, state, x) contract intact — no side-channel plumbing through
+the module tree.
+"""
+
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+
+from distributed_model_parallel_tpu.models import layers as L
+from distributed_model_parallel_tpu.models.transformer import (
+    AttentionFn,
+    multi_head_attention,
+)
+from distributed_model_parallel_tpu.ops.attention import dot_product_attention
+
+AUX_KEY = "moe_aux"
+
+
+def moe_feed_forward(
+    dim: int,
+    hidden_dim: int,
+    num_experts: int,
+    *,
+    top_k: int = 2,
+    capacity_factor: float = 1.25,
+    aux_loss_weight: float = 1e-2,
+    dropout_rate: float = 0.0,
+) -> L.Layer:
+    """Drop-in replacement for `transformer.feed_forward` on the
+    (hidden, mask) pair: each token runs through its top-k of
+    `num_experts` expert FFNs (dense -> gelu -> dense), gate-weighted.
+
+    Expert weights are stacked on a leading E axis — the axis
+    `parallel.expert_parallel.EXPERT_RULES` shards over 'expert'.
+    """
+    if not 1 <= top_k <= num_experts:
+        raise ValueError(
+            f"top_k {top_k} must be in [1, num_experts {num_experts}]"
+        )
+    e, k = num_experts, top_k
+    drop = L.dropout(dropout_rate)
+
+    def init(key):
+        kr, ki, ko = jax.random.split(key, 3)
+        params = {
+            "router": {"w": 0.02 * jax.random.normal(kr, (dim, e))},
+            "experts": {
+                "w_in": 0.02 * jax.random.normal(ki, (e, dim, hidden_dim)),
+                "b_in": jnp.zeros((e, hidden_dim)),
+                "w_out": 0.02 * jax.random.normal(ko, (e, hidden_dim, dim)),
+                "b_out": jnp.zeros((e, dim)),
+            },
+        }
+        return params, {AUX_KEY: jnp.zeros((), jnp.float32)}
+
+    def apply(params, state, x, ctx):
+        h, mask = x
+        b, t, _ = h.shape
+        cap = max(1, math.ceil(k * t * capacity_factor / e))
+
+        # Routing in f32 regardless of compute dtype: softmax + cumsum
+        # position bookkeeping are precision-sensitive and tiny.
+        gates = jax.nn.softmax(
+            h.astype(jnp.float32) @ params["router"]["w"].astype(jnp.float32)
+        )  # (B, T, E)
+        if mask is not None:
+            gates = gates * mask[..., None]
+
+        remaining = gates
+        counts = jnp.zeros((b, e), jnp.int32)  # tokens KEPT per expert
+        chosen = []  # (gate (B,T), expert one-hot (B,T,E), position (B,T))
+        for _ in range(k):
+            idx = jnp.argmax(remaining, axis=-1)               # (B, T)
+            raw = jax.nn.one_hot(idx, e, dtype=jnp.int32)      # (B, T, E)
+            gate = jnp.sum(remaining * raw, axis=-1)           # (B, T)
+            # Only tokens with a live gate claim a buffer rank: a masked
+            # token's all-zero row argmaxes to expert 0, and counting it
+            # in the cumsum would let a later round reuse an occupied
+            # slot (two tokens summed into one capacity row).
+            eligible = raw * (gate > 0)[..., None].astype(jnp.int32)
+            # Buffer slot: tokens earlier in the sequence fill first;
+            # previous rounds' KEPT assignments (counts) offset this
+            # round's. Kept ranks are consecutive (overflow ranks are
+            # all >= cap), so counts is exactly the next free slot.
+            pos_in_e = (
+                jnp.cumsum(eligible, axis=1) - eligible + counts[:, None, :]
+            )
+            pos = jnp.sum(pos_in_e * eligible, axis=-1)        # (B, T)
+            keep = (pos < cap) & (gate > 0)
+            kept = eligible * keep[..., None].astype(jnp.int32)
+            counts = counts + jnp.sum(kept, axis=1)
+            chosen.append((gate * keep, kept, pos))
+            # Retire this round's PICK (eligible, not just kept) so a
+            # token whose first choice overflowed falls to its genuine
+            # second choice next round instead of re-picking a full
+            # expert and being dropped outright.
+            remaining = remaining * (1 - eligible.astype(gates.dtype))
+
+        denom = sum(g for g, _, _ in chosen) + 1e-9
+        combine = sum(  # (B, T, E, C): gate weight at the token's slot
+            (g / denom)[..., None, None]
+            * oh[..., None]
+            * jax.nn.one_hot(p, cap)[:, :, None, :]
+            for g, oh, p in chosen
+        )
+        dispatch = (combine > 0).astype(h.dtype)
+
+        w = params["experts"]
+        xin = jnp.einsum("btec,btd->ebcd", dispatch, h)
+        y = jnp.einsum("ebcd,edh->ebch", xin, w["w_in"].astype(h.dtype))
+        y = jax.nn.gelu(
+            y + w["b_in"][:, None, None, :].astype(h.dtype),
+            approximate=False,
+        )
+        y = jnp.einsum("ebch,ehd->ebcd", y, w["w_out"].astype(h.dtype))
+        y = y + w["b_out"][:, None, None, :].astype(h.dtype)
+        out = jnp.einsum("btec,ebcd->btd", combine.astype(h.dtype), y)
+        out, _ = drop.apply({}, {}, out, ctx)
+
+        # Switch load-balance loss: E * Σ_e (dispatched fraction f_e) ·
+        # (mean router prob p_e), over VALID tokens.
+        n_valid = (
+            jnp.sum(mask.astype(jnp.float32))
+            if mask is not None
+            else jnp.float32(b * t)
+        ) + 1e-9
+        f_e = (
+            jnp.sum(chosen[0][1].astype(jnp.float32), axis=(0, 1)) / n_valid
+        )
+        p_e = jnp.sum(gates, axis=(0, 1)) / n_valid
+        aux = aux_loss_weight * e * jnp.sum(f_e * p_e)
+        return (out, mask), {AUX_KEY: aux}
+
+    return L.Layer(init, apply)
+
+
+def moe_encoder_layer(
+    dim: int,
+    num_heads: int,
+    hidden_dim: int,
+    num_experts: int,
+    *,
+    top_k: int = 2,
+    capacity_factor: float = 1.25,
+    aux_loss_weight: float = 1e-2,
+    dropout_rate: float = 0.0,
+    eps: float = 1e-12,
+    attention_fn: AttentionFn = dot_product_attention,
+) -> L.Layer:
+    """BERT post-LN block with the FFN replaced by a routed MoE:
+    LN(h + Attn(h)); LN(h + MoE(h)). Shape-compatible with
+    `transformer.encoder_layer`, so MoE and dense blocks interleave in
+    one `sequential` stack (the usual every-other-layer MoE recipe)."""
+    attn = multi_head_attention(
+        dim, num_heads, dropout_rate=dropout_rate, attention_fn=attention_fn
+    )
+    moe = moe_feed_forward(
+        dim, hidden_dim, num_experts, top_k=top_k,
+        capacity_factor=capacity_factor, aux_loss_weight=aux_loss_weight,
+        dropout_rate=dropout_rate,
+    )
+    ln1 = L.layernorm(dim, eps=eps)
+    ln2 = L.layernorm(dim, eps=eps)
+
+    def init(key):
+        ka, km, k1, k2 = jax.random.split(key, 4)
+        mp, ms = moe.init(km)
+        return (
+            {
+                "attn": attn.init(ka)[0],
+                "ln1": ln1.init(k1)[0],
+                "moe": mp,
+                "ln2": ln2.init(k2)[0],
+            },
+            {"moe": ms},
+        )
+
+    def apply(params, state, x, ctx):
+        h, mask = x
+        (a, _), _ = attn.apply(params["attn"], {}, (h, mask), ctx.child(0))
+        h, _ = ln1.apply(params["ln1"], {}, h + a, ctx)
+        (f, mask), moe_state = moe.apply(
+            params["moe"], state.get("moe", {}), (h, mask), ctx.child(1)
+        )
+        h, _ = ln2.apply(params["ln2"], {}, h + f, ctx)
+        return (h, mask), {"moe": moe_state}
+
+    return L.Layer(init, apply)

@@ -13,3 +13,7 @@ from distributed_model_parallel_tpu.parallel.tensor_parallel import (  # noqa: F
     MEGATRON_RULES,
     TensorParallelEngine,
 )
+from distributed_model_parallel_tpu.parallel.expert_parallel import (  # noqa: F401
+    EXPERT_RULES,
+    ExpertParallelEngine,
+)

@@ -85,6 +85,22 @@ def _cast_input(x, dtype):
     return x.astype(dtype)
 
 
+def aux_loss(state):
+    """Sum of differentiable penalties layers stash in their post-forward
+    state under the reserved key `"moe_aux"` (`models/moe.py`'s
+    load-balance loss). The GSPMD engines (DP / DDP / TensorParallel /
+    ExpertParallel) add this to the training loss they differentiate;
+    metrics keep reporting plain cross-entropy. PipelineEngine rejects
+    MoE stages at construction (its loss lives on the last stage only),
+    and SequenceParallelEngine builds a dense encoder. Returns 0.0 (a
+    no-op addend) when the model has no such layers."""
+    total = 0.0
+    for path, leaf in jax.tree_util.tree_leaves_with_path(state):
+        if path and getattr(path[-1], "key", None) == "moe_aux":
+            total = total + leaf
+    return total
+
+
 def _metrics(loss, logits, labels):
     # `loss` is the mean over valid rows; padding rows (label -1, from the
     # Loader's static-shape padding of a ragged final val batch) are
@@ -134,17 +150,19 @@ class DataParallelEngine:
                     params, model_state, images_c,
                     Context(train=True, rng=rng, dtype=cdt),
                 )
-                loss = cross_entropy(logits, labels)
-                return loss, (new_state, logits)
+                ce = cross_entropy(logits, labels)
+                # MoE load-balance penalties ride the state (aux_loss
+                # docstring); metrics stay plain CE.
+                return ce + aux_loss(new_state), (new_state, logits, ce)
 
-            (loss, (new_state, logits)), grads = jax.value_and_grad(
+            (_, (new_state, logits, ce)), grads = jax.value_and_grad(
                 loss_fn, has_aux=True
             )(ts.params, ts.model_state)
             params, opt_state = self.optimizer.update(
                 ts.params, ts.opt_state, grads, lr
             )
             new_ts = TrainState(params, new_state, opt_state, ts.step + 1)
-            return new_ts, _metrics(loss, logits, labels)
+            return new_ts, _metrics(ce, logits, labels)
 
         def eval_step(ts: TrainState, images, labels):
             logits, _ = self.model.apply(  # eval: no backward, no remat
@@ -235,12 +253,13 @@ class DDPEngine:
                     params, model_state, images_c,
                     Context(train=True, bn_axis=bn_axis, rng=rng, dtype=cdt),
                 )
-                loss = cross_entropy(logits, labels)
-                return loss, (new_state, logits)
+                ce = cross_entropy(logits, labels)
+                return ce + aux_loss(new_state), (new_state, logits, ce)
 
-            (loss, (new_state, logits)), grads = jax.value_and_grad(
+            (_, (new_state, logits, ce)), grads = jax.value_and_grad(
                 loss_fn, has_aux=True
             )(ts.params, ts.model_state)
+            loss = ce
             # THE all-reduce: mean-over-global-batch gradient in one fused
             # collective over ICI (replaces Reducer buckets + NCCL ring).
             grads = lax.pmean(grads, "data")

@@ -1,0 +1,63 @@
+"""Expert parallelism over the `'expert'` mesh axis — GSPMD style.
+
+Absent from the reference (SURVEY.md §2.3: "EP — absent"); first-class
+here. Like the tensor-parallel engine (`parallel/tensor_parallel.py`),
+this is NOT a hand-written dispatch/collective stack: the MoE layer
+(`models/moe.py`) expresses routing as dense einsums against one-hot
+dispatch/combine tensors, so placing
+
+    experts/w_in  (E, D, H)  -> P('expert', None, None)
+    experts/b_in  (E, H)     -> P('expert', None)
+    experts/w_out (E, H, D)  -> P('expert', None, None)
+    experts/b_out (E, D)     -> P('expert', None)
+
+on the weight pytree is sufficient: the XLA SPMD partitioner sees a
+token tensor sharded over 'data' meeting expert weights sharded over
+'expert' and inserts the token all-to-all exchange that GPU MoE
+frameworks (GShard, Switch, DeepSpeed-MoE) implement by hand — forward
+AND the mirrored gradient exchanges from the einsum transposes. Router
+weights and all non-expert parameters stay replicated.
+
+`ExpertParallelEngine` is the tensor-parallel engine with the expert
+rule set; concatenate `EXPERT_RULES + MEGATRON_RULES` on a
+(data, model, expert) mesh to run EP and TP together in one program.
+Per-device expert-weight bytes scale 1/E_mesh (tested in
+tests/test_expert_parallel.py), which is why EP exists.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Sequence, Tuple
+
+from jax.sharding import PartitionSpec as P
+
+from distributed_model_parallel_tpu.parallel.tensor_parallel import (
+    MEGATRON_RULES,
+    TensorParallelEngine,
+)
+
+# Sharding layout for the stacked expert weights (models/moe.py param
+# paths: .../moe/experts/{w_in,b_in,w_out,b_out}).
+EXPERT_RULES: Tuple[Tuple[str, P], ...] = (
+    (r"experts/w_in$", P("expert", None, None)),
+    (r"experts/b_in$", P("expert", None)),
+    (r"experts/w_out$", P("expert", None, None)),
+    (r"experts/b_out$", P("expert", None)),
+)
+
+
+@dataclasses.dataclass
+class ExpertParallelEngine(TensorParallelEngine):
+    """GSPMD expert(+data) parallelism: expert weights sharded over
+    'expert' by path rules, batch over 'data', token all-to-alls from
+    the partitioner. Same API as every other engine."""
+
+    rules: Sequence[Tuple[str, P]] = EXPERT_RULES
+
+
+__all__ = [
+    "EXPERT_RULES",
+    "MEGATRON_RULES",
+    "ExpertParallelEngine",
+]

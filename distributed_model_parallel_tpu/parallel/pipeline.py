@@ -205,6 +205,22 @@ class PipelineEngine:
             p_aval, s_aval = jax.eval_shape(stage.init, key_aval)
             self._param_avals.append(p_aval)
             self._state_avals.append(s_aval)
+        # MoE aux losses ride the layer state ("moe_aux" leaves), and the
+        # pipeline computes its loss on the LAST stage's devices only —
+        # folding other stages' aux in would need a differentiated
+        # psum('stage'), which this engine's autodiff discipline excludes
+        # (see _make_step). Refuse loudly rather than silently training
+        # an unbalanced router (only the GSPMD engines consume moe_aux).
+        for s_aval in self._state_avals:
+            for path, _ in jax.tree_util.tree_leaves_with_path(s_aval):
+                if path and getattr(path[-1], "key", None) == "moe_aux":
+                    raise NotImplementedError(
+                        "MoE layers are not supported inside PipelineEngine "
+                        "stages: the load-balance aux loss cannot reach the "
+                        "last-stage loss without a differentiated 'stage' "
+                        "collective. Train MoE models with the DP / DDP / "
+                        "TensorParallel / ExpertParallel engines."
+                    )
         self._psize = max(
             (_tree_size(a) for a in self._param_avals), default=1
         ) or 1

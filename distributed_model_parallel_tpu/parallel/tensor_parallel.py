@@ -43,6 +43,7 @@ from distributed_model_parallel_tpu.parallel.data_parallel import (
     _cast_input,
     _metrics,
     _place_batch,
+    aux_loss,
 )
 from distributed_model_parallel_tpu.training.checkpoint import _path_str
 from distributed_model_parallel_tpu.training.metrics import cross_entropy
@@ -92,8 +93,22 @@ class TensorParallelEngine:
 
     def __post_init__(self):
         mesh = self.mesh
-        if "model" not in mesh.axis_names:
-            raise ValueError("tensor-parallel mesh needs a 'model' axis")
+        # The mesh must carry every axis the rules shard over ('model'
+        # for MEGATRON_RULES, 'expert' for EXPERT_RULES, both when the
+        # rule sets are concatenated).
+        needed = set()
+        for _, spec in self.rules:
+            for part in spec:
+                if part is None:
+                    continue
+                parts = part if isinstance(part, tuple) else (part,)
+                needed.update(parts)
+        missing = needed - set(mesh.axis_names)
+        if missing:
+            raise ValueError(
+                f"mesh is missing axes {sorted(missing)} required by the "
+                f"sharding rules (mesh axes: {mesh.axis_names})"
+            )
         self._repl = NamedSharding(mesh, P())
         self._batch = NamedSharding(mesh, P(("data",)))
         cdt = self.compute_dtype
@@ -108,17 +123,17 @@ class TensorParallelEngine:
                     params, model_state, inputs_c,
                     Context(train=True, rng=rng, dtype=cdt),
                 )
-                loss = cross_entropy(logits, labels)
-                return loss, (new_state, logits)
+                ce = cross_entropy(logits, labels)
+                return ce + aux_loss(new_state), (new_state, logits, ce)
 
-            (loss, (new_state, logits)), grads = jax.value_and_grad(
+            (_, (new_state, logits, ce)), grads = jax.value_and_grad(
                 loss_fn, has_aux=True
             )(ts.params, ts.model_state)
             params, opt_state = self.optimizer.update(
                 ts.params, ts.opt_state, grads, lr
             )
             new_ts = TrainState(params, new_state, opt_state, ts.step + 1)
-            return new_ts, _metrics(loss, logits, labels)
+            return new_ts, _metrics(ce, logits, labels)
 
         def eval_step(ts: TrainState, inputs, labels):
             logits, _ = self.model.apply(

@@ -5,7 +5,7 @@ rendezvous (`code/distributed_training/model_parallel.py:57-58`) and a
 `--world-size` flag; device placement is rank-scripted. Here the world is a
 named `jax.sharding.Mesh` over axes
 
-    ('data', 'stage', 'model', 'seq')
+    ('data', 'stage', 'model', 'seq', 'expert')
 
 and every engine addresses devices by axis name:
   data   — batch sharding + gradient psum (DataParallelEngine/DDPEngine)
@@ -14,10 +14,12 @@ and every engine addresses devices by axis name:
            (TensorParallelEngine)
   seq    — sequence/context parallelism, ring attention / Ulysses
            all-to-all (SequenceParallelEngine)
+  expert — expert parallelism, MoE expert weights sharded E/N per device
+           (ExpertParallelEngine; dispatch all-to-alls from GSPMD)
 
 A `MeshSpec` replaces `--world-size N`: any axis left at -1 absorbs the
-remaining devices, so `MeshSpec(stage=4)` on 8 chips gives a (2, 4, 1, 1)
-mesh the way `--world-size 4` gave a 4-rank pipeline.
+remaining devices, so `MeshSpec(stage=4)` on 8 chips gives a
+(2, 4, 1, 1, 1) mesh the way `--world-size 4` gave a 4-rank pipeline.
 """
 
 from __future__ import annotations
@@ -30,7 +32,7 @@ import jax
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-AXES = ("data", "stage", "model", "seq")
+AXES = ("data", "stage", "model", "seq", "expert")
 
 
 @dataclasses.dataclass(frozen=True)
@@ -41,9 +43,10 @@ class MeshSpec:
     stage: int = 1
     model: int = 1
     seq: int = 1
+    expert: int = 1
 
-    def resolve(self, n_devices: int) -> tuple[int, int, int, int]:
-        dims = [self.data, self.stage, self.model, self.seq]
+    def resolve(self, n_devices: int) -> tuple[int, ...]:
+        dims = [self.data, self.stage, self.model, self.seq, self.expert]
         wild = [i for i, d in enumerate(dims) if d == -1]
         if len(wild) > 1:
             raise ValueError(f"at most one mesh axis may be -1, got {self}")

@@ -1,0 +1,239 @@
+"""MoE layer + expert-parallel engine tests (8-virtual-device CPU mesh).
+
+EP is absent from the reference; the correctness bar mirrors the other
+engines: sharding experts over 'expert' must be semantically invisible
+(same losses/params as the fully-replicated run) while expert weights
+are physically 1/E_mesh per device.
+"""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from distributed_model_parallel_tpu.models import layers as L
+from distributed_model_parallel_tpu.models.moe import (
+    moe_encoder_layer,
+    moe_feed_forward,
+)
+from distributed_model_parallel_tpu.models.transformer import feed_forward
+from distributed_model_parallel_tpu.parallel.data_parallel import (
+    DataParallelEngine,
+)
+from distributed_model_parallel_tpu.parallel.expert_parallel import (
+    EXPERT_RULES,
+    ExpertParallelEngine,
+)
+from distributed_model_parallel_tpu.runtime.mesh import MeshSpec, make_mesh
+from distributed_model_parallel_tpu.training.optim import SGD
+
+B, T, D = 4, 16, 32
+
+
+def _tokens(seed=0):
+    rng = np.random.RandomState(seed)
+    return jnp.asarray(rng.randn(B, T, D).astype(np.float32))
+
+
+def test_single_expert_full_capacity_equals_dense_ffn():
+    """E=1, k=1, capacity >= T: routing is the identity, so the MoE must
+    reproduce the plain FFN with the same weights exactly."""
+    dense = feed_forward(D, 2 * D)
+    moe = moe_feed_forward(D, 2 * D, 1, top_k=1, capacity_factor=1.0)
+    dp, _ = dense.init(jax.random.PRNGKey(0))
+    mp, ms = moe.init(jax.random.PRNGKey(1))
+    # transplant the dense weights into expert 0
+    mp = {
+        "router": mp["router"],
+        "experts": {
+            "w_in": dp["in"]["w"][None],
+            "b_in": dp["in"]["b"][None],
+            "w_out": dp["out"]["w"][None],
+            "b_out": dp["out"]["b"][None],
+        },
+    }
+    h = _tokens()
+    mask = jnp.asarray(np.random.RandomState(1).rand(B, T) > 0.3)
+    (want, _), _ = dense.apply(dp, {}, (h, mask), L.Context())
+    (got, _), st = moe.apply(mp, ms, (h, mask), L.Context())
+    # dense FFN transforms every token; MoE only dispatches valid ones —
+    # compare on the valid tokens, check masked rows are zero.
+    np.testing.assert_allclose(
+        np.asarray(got)[np.asarray(mask)],
+        np.asarray(want)[np.asarray(mask)],
+        rtol=2e-5, atol=2e-5,
+    )
+    np.testing.assert_array_equal(np.asarray(got)[~np.asarray(mask)], 0.0)
+    assert np.isfinite(float(st["moe_aux"]))
+
+
+def test_capacity_drops_overflow_tokens():
+    """A tiny capacity factor forces drops: dropped tokens produce zero
+    output (the residual stream carries them), kept tokens match the
+    generous-capacity run."""
+    tight = moe_feed_forward(D, 2 * D, 2, top_k=1, capacity_factor=0.25)
+    roomy = moe_feed_forward(D, 2 * D, 2, top_k=1, capacity_factor=2.0)
+    p, s = tight.init(jax.random.PRNGKey(0))
+    h = _tokens(2)
+    (yt, _), _ = tight.apply(p, s, (h, None), L.Context())
+    (yr, _), _ = roomy.apply(p, s, (h, None), L.Context())
+    zero_rows = ~np.any(np.asarray(yt) != 0, axis=-1)
+    assert zero_rows.any(), "expected capacity overflow to drop tokens"
+    np.testing.assert_allclose(
+        np.asarray(yt)[~zero_rows], np.asarray(yr)[~zero_rows],
+        rtol=2e-5, atol=2e-5,
+    )
+
+
+def test_masked_tokens_do_not_claim_buffer_slots():
+    """Regression: a masked token's all-zero gate row argmaxes to expert
+    0; if it claimed a cumsum rank, a round-2 token would collide into an
+    occupied capacity slot and two embeddings would sum. With the fix,
+    the masked run must equal the run where masked tokens are simply
+    absent from routing."""
+    moe = moe_feed_forward(D, 2 * D, 2, top_k=2, capacity_factor=4.0)
+    p, s = moe.init(jax.random.PRNGKey(3))
+    h = _tokens(5)
+    mask = jnp.ones((B, T), bool).at[:, 3].set(False)
+    (y_masked, _), _ = moe.apply(p, s, (h, mask), L.Context())
+    # reference: physically remove the masked token column
+    keep_idx = [i for i in range(T) if i != 3]
+    (y_removed, _), _ = moe.apply(
+        p, s, (h[:, keep_idx], None), L.Context()
+    )
+    np.testing.assert_allclose(
+        np.asarray(y_masked)[:, keep_idx], np.asarray(y_removed),
+        rtol=2e-5, atol=2e-5,
+    )
+    np.testing.assert_array_equal(np.asarray(y_masked)[:, 3], 0.0)
+
+
+def test_overflow_token_falls_to_second_choice():
+    """Regression: a token whose first-choice expert is full must fall
+    to its next-preferred expert in the following round, not re-pick the
+    full expert and be dropped.
+
+    Deterministic setup (B=1, T=3, E=3, cap=1): tokens 0/1 prefer
+    A then B; token 2 prefers A then C. Round 1 fills A with token 0;
+    round 2: token 0 takes B, token 1 loses B (full), and token 2 —
+    whose round-1 pick of A overflowed — must land in C, which is
+    empty. The old `remaining` update made token 2 re-pick the full A
+    and emit a zero row."""
+    t, e = 3, 3
+    moe = moe_feed_forward(D, 2 * D, e, top_k=2, capacity_factor=0.5)
+    p, s = moe.init(jax.random.PRNGKey(4))
+    w = np.zeros((D, e), np.float32)
+    w[0] = [2.0, 1.0, 0.0]   # direction 0: A then B
+    w[1] = [1.5, 0.0, 1.0]   # direction 1: A then C
+    p = {"router": {"w": jnp.asarray(w)}, "experts": p["experts"]}
+    h = np.zeros((1, t, D), np.float32)
+    h[0, 0, 0] = 1.0  # token 0 -> A then B
+    h[0, 1, 0] = 1.0  # token 1 -> A then B
+    h[0, 2, 1] = 1.0  # token 2 -> A then C
+    (y, _), _ = moe.apply(p, s, (jnp.asarray(h), None), L.Context())
+    y = np.asarray(y)
+    assert np.any(y[0, 0] != 0)  # kept in A (and B)
+    assert np.any(y[0, 2] != 0), (
+        "overflow token was dropped instead of falling to its free "
+        "second-choice expert"
+    )
+
+
+def _moe_classifier(num_experts, num_classes=4, top_k=2):
+    """Tokens (B, T, D) -> logits: one MoE encoder block + mean-pool head."""
+    block = moe_encoder_layer(
+        D, 4, 2 * D, num_experts, top_k=top_k, dropout_rate=0.0
+    )
+    head = L.linear(D, num_classes)
+
+    def init(key):
+        kb, kh = jax.random.split(key)
+        bp, bs = block.init(kb)
+        return {"block": bp, "head": head.init(kh)[0]}, {"block": bs}
+
+    def apply(params, state, x, ctx):
+        (h, _), bs = block.apply(
+            params["block"], state.get("block", {}), (x, None), ctx
+        )
+        logits, _ = head.apply(params["head"], {}, h.mean(axis=1), ctx)
+        return logits, {"block": bs}
+
+    return L.Layer(init, apply)
+
+
+def _batch(seed=0, n=8, ncls=4):
+    rng = np.random.RandomState(seed)
+    labels = rng.randint(0, ncls, size=(n,)).astype(np.int32)
+    means = np.random.RandomState(99).randn(ncls, D).astype(np.float32)
+    x = rng.randn(n, T, D).astype(np.float32) * 0.5 + means[labels][:, None]
+    return x, labels
+
+
+def _run(engine, n_steps=3, lr=0.05):
+    ts = engine.init_state(jax.random.PRNGKey(0))
+    x, y = engine.shard_batch(*_batch())
+    losses = []
+    for _ in range(n_steps):
+        ts, m = engine.train_step(ts, x, y, jnp.float32(lr))
+        losses.append(float(m["loss_sum"]) / float(m["count"]))
+    return ts, losses
+
+
+def test_moe_trains_and_aux_loss_flows():
+    """Top-2 MoE classifier learns under the DP engine, and the router
+    receives gradient through the engines' aux_loss hook (router weights
+    move even though the router only feeds gate values + aux)."""
+    mesh = make_mesh(MeshSpec(data=8))
+    eng = DataParallelEngine(
+        _moe_classifier(4), SGD(), mesh, donate=False
+    )
+    ts0 = eng.init_state(jax.random.PRNGKey(0))
+    ts, losses = _run(eng, n_steps=6)
+    assert losses[-1] < losses[0]
+    r0 = np.asarray(ts0.params["block"]["moe"]["router"]["w"])
+    r1 = np.asarray(ts.params["block"]["moe"]["router"]["w"])
+    assert np.abs(r1 - r0).max() > 0
+    assert np.isfinite(float(ts.model_state["block"]["moe"]["moe_aux"]))
+
+
+def test_ep_matches_replicated_trajectory():
+    """(data=2, expert=4) mesh == plain 8-way DP on the same MoE model:
+    the partitioner's token all-to-alls are numerically invisible."""
+    ep_mesh = make_mesh(MeshSpec(data=2, expert=4))
+    dp_mesh = make_mesh(MeshSpec(data=8))
+    model = _moe_classifier(4)
+    _, losses_ep = _run(
+        ExpertParallelEngine(model, SGD(), ep_mesh, donate=False)
+    )
+    _, losses_dp = _run(
+        DataParallelEngine(model, SGD(), dp_mesh, donate=False)
+    )
+    np.testing.assert_allclose(losses_ep, losses_dp, rtol=1e-4)
+
+
+def test_ep_weights_physically_sharded():
+    """Each device must hold E/4 experts' weights, not all E."""
+    mesh = make_mesh(MeshSpec(data=2, expert=4))
+    eng = ExpertParallelEngine(_moe_classifier(4), SGD(), mesh, donate=False)
+    ts = eng.init_state(jax.random.PRNGKey(0))
+    w_in = ts.params["block"]["moe"]["experts"]["w_in"]
+    assert w_in.shape[0] == 4
+    for shard in w_in.addressable_shards:
+        assert shard.data.shape[0] == 1  # 4 experts / 4-way 'expert' axis
+
+
+def test_rules_require_expert_axis():
+    mesh = make_mesh(MeshSpec(data=8))  # no expert axis sized > 1 is fine;
+    # the axis exists in AXES, so construction succeeds and shards E over
+    # a size-1 axis (degenerate but valid). A mesh genuinely missing the
+    # axis name must be rejected:
+    import numpy as _np
+    from jax.sharding import Mesh
+
+    flat = Mesh(
+        _np.asarray(jax.devices()).reshape(8, 1), axis_names=("data", "model")
+    )
+    with pytest.raises(ValueError, match="expert"):
+        ExpertParallelEngine(_moe_classifier(4), SGD(), flat, donate=False)
