@@ -45,6 +45,15 @@ class BertConfig:
     dropout_rate: float = 0.1
     layer_norm_eps: float = 1e-12
     pad_token_id: int = 0
+    # Mixture-of-Experts: num_experts > 0 swaps the FFN of every
+    # `moe_every`-th encoder layer (the 2nd, 4th, ... — the standard
+    # alternating recipe) for a routed MoE (`models/moe.py`); train with
+    # the GSPMD engines (`parallel/expert_parallel.py` shards experts
+    # over the 'expert' mesh axis).
+    num_experts: int = 0
+    moe_every: int = 2
+    moe_top_k: int = 2
+    moe_capacity_factor: float = 1.25
 
 
 BERT_BASE = BertConfig()
@@ -104,17 +113,40 @@ def _embeddings(cfg: BertConfig) -> L.Layer:
 def _encoder_blocks(
     cfg: BertConfig, attention_fn: AttentionFn
 ) -> List[L.Layer]:
-    return [
-        encoder_layer(
-            cfg.hidden_size,
-            cfg.num_heads,
-            cfg.intermediate_size,
-            dropout_rate=cfg.dropout_rate,
-            eps=cfg.layer_norm_eps,
-            attention_fn=attention_fn,
+    if cfg.num_experts > 0 and cfg.moe_every < 1:
+        raise ValueError(
+            f"moe_every must be >= 1 when num_experts > 0, got "
+            f"{cfg.moe_every} (1 = every layer, 2 = every other, ...)"
         )
-        for _ in range(cfg.num_layers)
-    ]
+    blocks = []
+    for i in range(cfg.num_layers):
+        is_moe = cfg.num_experts > 0 and (i + 1) % cfg.moe_every == 0
+        if is_moe:
+            from distributed_model_parallel_tpu.models.moe import (
+                moe_encoder_layer,
+            )
+
+            blocks.append(moe_encoder_layer(
+                cfg.hidden_size,
+                cfg.num_heads,
+                cfg.intermediate_size,
+                cfg.num_experts,
+                top_k=cfg.moe_top_k,
+                capacity_factor=cfg.moe_capacity_factor,
+                dropout_rate=cfg.dropout_rate,
+                eps=cfg.layer_norm_eps,
+                attention_fn=attention_fn,
+            ))
+        else:
+            blocks.append(encoder_layer(
+                cfg.hidden_size,
+                cfg.num_heads,
+                cfg.intermediate_size,
+                dropout_rate=cfg.dropout_rate,
+                eps=cfg.layer_norm_eps,
+                attention_fn=attention_fn,
+            ))
+    return blocks
 
 
 def _cls_head(cfg: BertConfig, num_classes: int) -> L.Layer:

@@ -101,6 +101,7 @@ def moe_feed_forward(
         remaining = gates
         counts = jnp.zeros((b, e), jnp.int32)  # tokens KEPT per expert
         chosen = []  # (gate (B,T), expert one-hot (B,T,E), position (B,T))
+        top1_assign = None  # round-0 PRE-capacity picks, for the aux loss
         for _ in range(k):
             idx = jnp.argmax(remaining, axis=-1)               # (B, T)
             raw = jax.nn.one_hot(idx, e, dtype=jnp.int32)      # (B, T, E)
@@ -118,6 +119,8 @@ def moe_feed_forward(
                 jnp.cumsum(eligible, axis=1) - eligible + counts[:, None, :]
             )
             pos = jnp.sum(pos_in_e * eligible, axis=-1)        # (B, T)
+            if top1_assign is None:
+                top1_assign = eligible
             keep = (pos < cap) & (gate > 0)
             kept = eligible * keep[..., None].astype(jnp.int32)
             counts = counts + jnp.sum(kept, axis=1)
@@ -149,15 +152,18 @@ def moe_feed_forward(
         out = jnp.einsum("btec,ebcd->btd", combine.astype(h.dtype), y)
         out, _ = drop.apply({}, {}, out, ctx)
 
-        # Switch load-balance loss: E * Σ_e (dispatched fraction f_e) ·
-        # (mean router prob p_e), over VALID tokens.
+        # Switch load-balance loss: E * Σ_e (assigned fraction f_e) ·
+        # (mean router prob p_e), over VALID tokens. f_e counts the
+        # router's PRE-capacity top-1 picks: post-drop counts saturate at
+        # the capacity exactly when an expert is overloaded, which would
+        # blind the penalty to the collapse it exists to prevent.
         n_valid = (
             jnp.sum(mask.astype(jnp.float32))
             if mask is not None
             else jnp.float32(b * t)
         ) + 1e-9
         f_e = (
-            jnp.sum(chosen[0][1].astype(jnp.float32), axis=(0, 1)) / n_valid
+            jnp.sum(top1_assign.astype(jnp.float32), axis=(0, 1)) / n_valid
         )
         p_e = jnp.sum(gates, axis=(0, 1)) / n_valid
         aux = aux_loss_weight * e * jnp.sum(f_e * p_e)

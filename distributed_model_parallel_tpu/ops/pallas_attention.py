@@ -76,9 +76,13 @@ def _mask_window(mask_ref, ki: int, bk: int):
 def _tile_logits(q, k, scale, valid, causal, qi, ki, bq, bk):
     """One (bq, bk) logits tile: scale·q@kᵀ with mask/causal applied —
     shared by the forward recurrence and both backward kernels so the
-    recomputed probabilities match the saved LSE bit-for-bit."""
-    s = lax.dot_general(  # (bq, bk) on the MXU
-        q * scale, k,
+    recomputed probabilities match the saved LSE bit-for-bit.
+
+    q/k stay in their storage dtype (bf16 inputs hit the MXU's native
+    bf16 path — ~4x the f32 matmul rate on v5e) with f32 accumulation;
+    the scale is applied to the f32 product, exactly."""
+    s = scale * lax.dot_general(  # (bq, bk) on the MXU
+        q, k,
         dimension_numbers=(((1,), (1,)), ((), ())),
         preferred_element_type=jnp.float32,
     )
@@ -112,15 +116,15 @@ def _flash_step(q_ref, k_ref, v_ref, mask_ref, o_ref, lse_ref,
         acc_scr[:] = jnp.zeros_like(acc_scr[:])
 
     def compute():
-        q = q_ref[0, 0].astype(jnp.float32)              # (bq, dh)
-        k = k_ref[0, 0].astype(jnp.float32)              # (bk, dh)
-        v = v_ref[0, 0].astype(jnp.float32)              # (bk, dh)
+        q = q_ref[0, 0]                                  # (bq, dh)
+        k = k_ref[0, 0]                                  # (bk, dh)
+        v = v_ref[0, 0]                                  # (bk, dh)
         valid = _mask_window(mask_ref, ki, bk)
         s = _tile_logits(q, k, scale, valid, causal, qi, ki, bq, bk)
 
         m_prev = m_scr[:]                                # (bq, 1)
         m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
-        p = jnp.exp(s - m_new)                           # (bq, bk)
+        p = jnp.exp(s - m_new)                           # (bq, bk) f32
         if valid is not None or causal:
             # exp(_NEG - m_new) underflows to 0 for any finite m_new, but
             # a row that is masked in EVERY tile so far has m_new == _NEG
@@ -129,8 +133,10 @@ def _flash_step(q_ref, k_ref, v_ref, mask_ref, o_ref, lse_ref,
             p = jnp.where(s == _NEG, 0.0, p)
         corr = jnp.exp(m_prev - m_new)                   # (bq, 1)
         l_scr[:] = l_scr[:] * corr + jnp.sum(p, axis=-1, keepdims=True)
+        # p rides the MXU in the value dtype (bf16 for bf16 models —
+        # p in [0,1] loses nothing material); accumulation stays f32.
         acc_scr[:] = acc_scr[:] * corr + lax.dot_general(
-            p, v,
+            p.astype(v.dtype), v,
             dimension_numbers=(((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
         )
@@ -282,21 +288,21 @@ def _bwd_dq_step(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, mask_ref,
         dq_scr[:] = jnp.zeros_like(dq_scr[:])
 
     def compute():
-        q = q_ref[0, 0].astype(jnp.float32)
-        k = k_ref[0, 0].astype(jnp.float32)
-        v = v_ref[0, 0].astype(jnp.float32)
-        do = do_ref[0, 0].astype(jnp.float32)
+        q = q_ref[0, 0]
+        k = k_ref[0, 0]
+        v = v_ref[0, 0]
+        do = do_ref[0, 0]
         valid = _mask_window(mask_ref, ki, bk)
         s = _tile_logits(q, k, scale, valid, causal, qi, ki, bq, bk)
-        p = jnp.exp(s - _rows(lse_ref))                  # (bq, bk)
+        p = jnp.exp(s - _rows(lse_ref))                  # (bq, bk) f32
         dp = lax.dot_general(                            # dO @ Vᵀ
             do, v,
             dimension_numbers=(((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
         )
-        ds = p * (dp - _rows(delta_ref))
+        ds = p * (dp - _rows(delta_ref))                 # f32
         dq_scr[:] = dq_scr[:] + scale * lax.dot_general(
-            ds, k,
+            ds.astype(k.dtype), k,  # MXU-native dtype, f32 accumulate
             dimension_numbers=(((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
         )
@@ -325,15 +331,15 @@ def _bwd_dkv_step(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, mask_ref,
         dv_scr[:] = jnp.zeros_like(dv_scr[:])
 
     def compute():
-        q = q_ref[0, 0].astype(jnp.float32)
-        k = k_ref[0, 0].astype(jnp.float32)
-        v = v_ref[0, 0].astype(jnp.float32)
-        do = do_ref[0, 0].astype(jnp.float32)
+        q = q_ref[0, 0]
+        k = k_ref[0, 0]
+        v = v_ref[0, 0]
+        do = do_ref[0, 0]
         valid = _mask_window(mask_ref, ki, bk)
         s = _tile_logits(q, k, scale, valid, causal, qi, ki, bq, bk)
-        p = jnp.exp(s - _rows(lse_ref))                  # (bq, bk)
+        p = jnp.exp(s - _rows(lse_ref))                  # (bq, bk) f32
         dv_scr[:] = dv_scr[:] + lax.dot_general(         # Pᵀ @ dO
-            p, do,
+            p.astype(do.dtype), do,
             dimension_numbers=(((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
         )
@@ -342,9 +348,9 @@ def _bwd_dkv_step(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, mask_ref,
             dimension_numbers=(((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
         )
-        ds = p * (dp - _rows(delta_ref))
+        ds = p * (dp - _rows(delta_ref))                 # f32
         dk_scr[:] = dk_scr[:] + scale * lax.dot_general(  # dSᵀ @ Q
-            ds, q,
+            ds.astype(q.dtype), q,
             dimension_numbers=(((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
         )
@@ -513,11 +519,16 @@ def flash_attention(
     *,
     scale: Optional[float] = None,
     causal: bool = False,
-    block_q: int = 128,
-    block_k: int = 128,
+    block_q: int = 512,
+    block_k: int = 1024,
     interpret: Optional[bool] = None,
 ) -> jax.Array:
     """Drop-in `attention_fn` backed by the Pallas flash kernels.
+
+    Default blocks (512, 1024) are tuned on v5e: the (bq, bk) grid-step
+    count — not matmul rate — capped throughput at the old (128, 128)
+    (measured 7 -> 24 TF/s forward at T=8k, B=2, H=8, dh=64; shorter
+    sequences shrink blocks to fit automatically).
 
     `interpret=None` auto-selects: compiled on TPU, interpreter
     elsewhere (tests). See module docstring for scope.

@@ -90,10 +90,11 @@ def aux_loss(state):
     state under the reserved key `"moe_aux"` (`models/moe.py`'s
     load-balance loss). The GSPMD engines (DP / DDP / TensorParallel /
     ExpertParallel) add this to the training loss they differentiate;
-    metrics keep reporting plain cross-entropy. PipelineEngine rejects
-    MoE stages at construction (its loss lives on the last stage only),
-    and SequenceParallelEngine builds a dense encoder. Returns 0.0 (a
-    no-op addend) when the model has no such layers."""
+    metrics keep reporting plain cross-entropy. PipelineEngine and
+    SequenceParallelEngine reject MoE models at construction (their
+    losses live on one stage/shard, which would silently drop the aux
+    leaves). Returns 0.0 (a no-op addend) when the model has no such
+    layers."""
     total = 0.0
     for path, leaf in jax.tree_util.tree_leaves_with_path(state):
         if path and getattr(path[-1], "key", None) == "moe_aux":

@@ -90,6 +90,17 @@ class SequenceParallelEngine:
                 f"got {self.attention!r}"
             )
         cfg = self.cfg
+        if getattr(cfg, "num_experts", 0) > 0:
+            # MoE routing is per-shard under 'seq' sharding and the loss
+            # lives on the [CLS] shard only, so the moe_aux load-balance
+            # leaves would be silently dropped (and per-shard capacity
+            # semantics differ from the dense model). Refuse loudly —
+            # the GSPMD engines (DP/DDP/TP/EP) are the MoE path.
+            raise NotImplementedError(
+                "BertConfig.num_experts > 0 is not supported by "
+                "SequenceParallelEngine; train MoE models with the "
+                "DP / DDP / TensorParallel / ExpertParallel engines."
+            )
         attn_fn = partial(ATTENTION[self.attention], axis_name="seq")
         self._repl = NamedSharding(mesh, P())
         self._batch = NamedSharding(mesh, P(("data",), ("seq",)))

@@ -1,0 +1,125 @@
+"""Real-TPU attention micro-benchmark: Pallas flash kernels vs the XLA
+dot-product path, forward and forward+backward, across sequence lengths.
+
+Timing uses value-fetch synchronization (see RESULTS.md measurement
+note / bench.py `_sync`): each measured window ends in a scalar fetch
+that cannot complete before the chained work ran — `block_until_ready`
+is not a reliable barrier on a tunneled backend.
+
+Usage (on a host with a TPU):
+    python experiments/flash_attention_bench.py \
+        [--out experiments/flash_attention_bench.json]
+Prints one markdown table row per (T, path); the XLA path skips lengths
+whose (B, H, T, T) f32 logits would not fit HBM.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from distributed_model_parallel_tpu.ops.attention import (
+    dot_product_attention,
+)
+from distributed_model_parallel_tpu.ops.pallas_attention import (
+    flash_attention,
+)
+
+B, H, DH = 2, 8, 64
+
+
+def _qkv(t, dtype=jnp.bfloat16, seed=0):
+    rng = np.random.RandomState(seed)
+    mk = lambda: jnp.asarray(
+        rng.randn(B, t, H, DH).astype(np.float32), dtype
+    )
+    return mk(), mk(), mk()
+
+
+def _time(fn, *args, iters=20, warmup=3):
+    """Median-free simple timing with a value-fetch barrier."""
+    out = None
+    for _ in range(warmup):
+        out = fn(*args)
+    float(jnp.sum(out))  # sync warmup
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = fn(*args)
+    float(jnp.sum(out))  # the fetch IS the barrier
+    return (time.perf_counter() - t0) / iters
+
+
+def attention_tflops(t, seconds, bwd=False, causal=False):
+    """2 matmuls of 2*B*H*T^2*DH flops each forward; backward ~2.5x the
+    forward matmul work (dq, dk, dv, plus the recomputed logits).
+    Causal attention computes half the tiles, so half the flops."""
+    fwd = 4 * B * H * t * t * DH * (0.5 if causal else 1.0)
+    total = fwd * (1 + 2.5) if bwd else fwd
+    return total / seconds / 1e12
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--out", default=None)
+    ap.add_argument("--causal", action="store_true")
+    args = ap.parse_args()
+
+    dev = jax.devices()[0]
+    print(f"device: {dev.device_kind} ({dev.platform})")
+    kw = {"causal": args.causal}
+    rows = []
+    print("| T | path | fwd ms | fwd TF/s | fwd+bwd ms | fwd+bwd TF/s |")
+    print("|---|---|---|---|---|---|")
+    for t in (1024, 2048, 4096, 8192, 16384, 32768):
+        q, k, v = _qkv(t)
+        # XLA materializes (B, H, T, T) f32 logits (+ probs in backward):
+        # cap it where that no longer fits the 16 GB HBM.
+        xla_ok = B * H * t * t * 4 * 3 < 12e9
+        paths = [("flash", flash_attention)] + (
+            [("xla", dot_product_attention)] if xla_ok else []
+        )
+        for name, fn in paths:
+            f = jax.jit(lambda q, k, v, fn=fn: fn(q, k, v, **kw))
+            g = jax.jit(
+                jax.grad(
+                    lambda q, k, v, fn=fn: jnp.sum(
+                        fn(q, k, v, **kw).astype(jnp.float32) ** 2
+                    ),
+                    argnums=(0, 1, 2),
+                )
+            )
+            tf = _time(f, q, k, v)
+            tg = _time(lambda *a: g(*a)[0], q, k, v)
+            row = {
+                "T": t, "path": name,
+                "fwd_ms": round(tf * 1e3, 2),
+                "fwd_tflops": round(
+                    attention_tflops(t, tf, causal=args.causal), 1
+                ),
+                "fwdbwd_ms": round(tg * 1e3, 2),
+                "fwdbwd_tflops": round(
+                    attention_tflops(t, tg, True, causal=args.causal), 1
+                ),
+            }
+            rows.append(row)
+            print(
+                f"| {t} | {name} | {row['fwd_ms']} | {row['fwd_tflops']} "
+                f"| {row['fwdbwd_ms']} | {row['fwdbwd_tflops']} |",
+                flush=True,
+            )
+    if args.out:
+        with open(args.out, "w") as f:
+            json.dump(
+                {"device": dev.device_kind, "B": B, "H": H, "DH": DH,
+                 "causal": args.causal, "rows": rows},
+                f, indent=2,
+            )
+
+
+if __name__ == "__main__":
+    main()

@@ -224,6 +224,86 @@ def test_ep_weights_physically_sharded():
         assert shard.data.shape[0] == 1  # 4 experts / 4-way 'expert' axis
 
 
+def test_moe_bert_trains_expert_parallel():
+    """BertConfig(num_experts=4): every 2nd encoder layer is MoE; the
+    whole model trains under the EP engine with experts 1/4 per device
+    and the aux loss flowing from inside the `sequential` stack."""
+    from distributed_model_parallel_tpu.models.bert import (
+        BertConfig,
+        bert_for_classification,
+    )
+
+    cfg = BertConfig(
+        vocab_size=67, hidden_size=32, num_layers=2, num_heads=4,
+        intermediate_size=64, max_position=16, dropout_rate=0.0,
+        num_experts=4, moe_every=2,
+    )
+    mesh = make_mesh(MeshSpec(data=2, expert=4))
+    eng = ExpertParallelEngine(
+        bert_for_classification(4, cfg), SGD(), mesh, donate=False
+    )
+    ts = eng.init_state(jax.random.PRNGKey(0))
+    rng = np.random.RandomState(0)
+    ids = rng.randint(1, 67, size=(8, 16)).astype(np.int32)
+    ids[:, -3:] = 0  # padding exercises the masked-routing path
+    labels = rng.randint(0, 4, size=(8,)).astype(np.int32)
+    ids_s, labels_s = eng.shard_batch(ids, labels)
+    losses = []
+    for _ in range(3):
+        ts, m = eng.train_step(ts, ids_s, labels_s, jnp.float32(0.05))
+        losses.append(float(m["loss_sum"]) / float(m["count"]))
+    assert losses[-1] < losses[0]
+    # layer "1" (the 2nd) is the MoE one; its experts are 'expert'-sharded
+    w_in = ts.params["blocks"]["1"]["moe"]["experts"]["w_in"]
+    assert w_in.addressable_shards[0].data.shape[0] == 1
+    aux = ts.model_state["blocks"]["1"]["moe"]["moe_aux"]
+    assert np.isfinite(float(aux)) and float(aux) > 0
+
+
+def test_moe_rejected_by_single_shard_loss_engines():
+    """PipelineEngine and SequenceParallelEngine compute their loss on
+    one stage/shard; MoE aux leaves would be silently dropped, so both
+    must refuse at construction."""
+    from distributed_model_parallel_tpu.models.bert import BertConfig
+    from distributed_model_parallel_tpu.parallel.pipeline import (
+        PipelineEngine,
+    )
+    from distributed_model_parallel_tpu.parallel.sequence_parallel import (
+        SequenceParallelEngine,
+    )
+
+    cfg = BertConfig(
+        vocab_size=67, hidden_size=32, num_layers=2, num_heads=4,
+        intermediate_size=64, max_position=16, dropout_rate=0.0,
+        num_experts=4, moe_every=1,
+    )
+    with pytest.raises(NotImplementedError, match="MoE"):
+        SequenceParallelEngine(
+            cfg, 4, SGD(), make_mesh(MeshSpec(data=2, seq=4))
+        )
+    moe_stage = moe_encoder_layer(D, 4, 2 * D, 2)
+    with pytest.raises(NotImplementedError, match="MoE"):
+        PipelineEngine(
+            [moe_stage, moe_stage], SGD(),
+            make_mesh(MeshSpec(data=4, stage=2)),
+        )
+
+
+def test_moe_every_zero_rejected():
+    from distributed_model_parallel_tpu.models.bert import (
+        BertConfig,
+        bert_for_classification,
+    )
+
+    cfg = BertConfig(
+        vocab_size=67, hidden_size=32, num_layers=2, num_heads=4,
+        intermediate_size=64, max_position=16,
+        num_experts=4, moe_every=0,
+    )
+    with pytest.raises(ValueError, match="moe_every"):
+        bert_for_classification(4, cfg)
+
+
 def test_rules_require_expert_axis():
     mesh = make_mesh(MeshSpec(data=8))  # no expert axis sized > 1 is fine;
     # the axis exists in AXES, so construction succeeds and shards E over
