@@ -53,6 +53,32 @@ class GPTConfig:
     pad_token_id: Optional[int] = None
 
 
+def stem_apply(params, ids, cfg: GPTConfig, drop: L.Layer, ctx, *,
+               positions=None):
+    """The LM stem math, shared by the dense `_lm_stem` Layer and the
+    sequence-parallel engine (which passes its shard's `positions`
+    slice) — one copy, no drift. Returns (hidden, mask)."""
+    mask = (
+        jnp.ones(ids.shape, jnp.bool_) if cfg.pad_token_id is None
+        else ids != cfg.pad_token_id
+    )
+    pos = (
+        params["position"][: ids.shape[1]] if positions is None
+        else positions
+    )
+    h = jnp.take(params["word"], ids, axis=0) + pos[None]
+    if ctx.dtype is not None:
+        h = h.astype(ctx.dtype)
+    h, _ = drop.apply({}, {}, h, ctx)
+    return h, mask
+
+
+def head_apply(params, h):
+    """Untied vocabulary projection; logits in f32. Shared by the dense
+    Layer and the sequence-parallel engine."""
+    return h.astype(jnp.float32) @ params["w"]
+
+
 def _lm_stem(cfg: GPTConfig) -> L.Layer:
     """token + position embeddings, dropout. Output (hidden, mask)."""
     drop = L.dropout(cfg.dropout_rate)
@@ -69,19 +95,7 @@ def _lm_stem(cfg: GPTConfig) -> L.Layer:
         }, {}
 
     def apply(params, state, ids, ctx):
-        t = ids.shape[1]
-        mask = (
-            jnp.ones(ids.shape, jnp.bool_) if cfg.pad_token_id is None
-            else ids != cfg.pad_token_id
-        )
-        h = (
-            jnp.take(params["word"], ids, axis=0)
-            + params["position"][None, :t, :]
-        )
-        if ctx.dtype is not None:
-            h = h.astype(ctx.dtype)
-        h, _ = drop.apply({}, {}, h, ctx)
-        return (h, mask), state
+        return stem_apply(params, ids, cfg, drop, ctx), state
 
     return L.Layer(init, apply)
 
@@ -96,7 +110,7 @@ def _lm_head(cfg: GPTConfig) -> L.Layer:
 
     def apply(params, state, x, ctx):
         h, _ = x
-        return h.astype(jnp.float32) @ params["w"], state
+        return head_apply(params, h), state
 
     return L.Layer(init, apply)
 
@@ -144,6 +158,27 @@ def lm_loss_fn(cfg: GPTConfig):
     of raw `lm_loss` so loss masking can't silently fall out of sync
     with the attention mask."""
     return partial(lm_loss, pad_token_id=cfg.pad_token_id)
+
+
+def lm_targets(ids, pad_token_id: Optional[int] = None):
+    """Per-position next-token targets: targets[t] = ids[t+1], with the
+    final position (and padding) marked -1 (the exclusion label
+    `training/metrics.cross_entropy` masks).
+
+    Computed on the HOST so sequence-parallel training can shard targets
+    alongside ids — every shard then scores its own positions locally,
+    including the shard-boundary token, with no cross-shard fetch."""
+    import numpy as np
+
+    # int32 BEFORE the -1 fills: in an unsigned ids dtype the sentinel
+    # would wrap to a huge valid-looking label and defeat the exclusion.
+    ids = np.asarray(ids).astype(np.int32)
+    targets = np.concatenate(
+        [ids[:, 1:], np.full((ids.shape[0], 1), -1, np.int32)], axis=1
+    )
+    if pad_token_id is not None:
+        targets = np.where(targets == pad_token_id, -1, targets)
+    return targets.astype(np.int32)
 
 
 def lm_loss(logits: jax.Array, ids: jax.Array,

@@ -7,6 +7,7 @@ from distributed_model_parallel_tpu.parallel.pipeline import (  # noqa: F401
     PipelineEngine,
 )
 from distributed_model_parallel_tpu.parallel.sequence_parallel import (  # noqa: F401
+    CausalLMSequenceParallelEngine,
     SequenceParallelEngine,
 )
 from distributed_model_parallel_tpu.parallel.tensor_parallel import (  # noqa: F401

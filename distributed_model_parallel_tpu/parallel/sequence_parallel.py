@@ -227,3 +227,184 @@ class SequenceParallelEngine:
         ids_arr = _place_batch((ids,), self._batch)[0]
         labels_arr = _place_batch((labels,), self._labels)[0]
         return ids_arr, labels_arr
+
+
+@dataclasses.dataclass
+class CausalLMSequenceParallelEngine:
+    """Decoder-only (GPT-family) LANGUAGE-MODEL training with
+    'seq'-sharded activations — the long-context path for `models/gpt`.
+
+    Parameters are identical in structure to `gpt_lm(cfg)`, so dense
+    checkpoints interoperate. Unlike the classification engine (whose
+    loss lives on the [CLS] shard alone), the next-token loss decomposes
+    per position: `shard_batch` builds targets on the HOST
+    (`models.gpt.lm_targets` — shard-boundary tokens included) and
+    shards them alongside the ids, so every shard scores its own tokens
+    with NO differentiated cross-shard reduction. Per-shard gradients of
+    the local loss SUM are complementary pieces of the total; one fused
+    `psum('seq','data')` after `jax.grad`, divided by the global valid-
+    token count, yields exactly the dense mean-loss gradient.
+
+    The attention rings rotate K/V with `causal=True`: blocks arriving
+    from later shards are fully hidden, the resident block is
+    triangular (`ops/ring_attention.py`)."""
+
+    cfg: Any  # models.gpt.GPTConfig
+    optimizer: SGD
+    mesh: Mesh
+    attention: str = "ring"
+    donate: bool = True
+    compute_dtype: Any = None
+    remat: bool = False
+
+    def __post_init__(self):
+        from distributed_model_parallel_tpu.models.gpt import (
+            decoder_blocks,
+            gpt_lm,
+            head_apply as lm_head_apply,
+            lm_targets,
+            stem_apply as lm_stem_apply,
+        )
+
+        mesh = self.mesh
+        if "seq" not in mesh.axis_names:
+            raise ValueError("sequence-parallel mesh needs a 'seq' axis")
+        if self.attention not in ATTENTION:
+            raise ValueError(
+                f"attention must be one of {sorted(ATTENTION)}, "
+                f"got {self.attention!r}"
+            )
+        cfg = self.cfg
+        self._lm_targets = partial(
+            lm_targets, pad_token_id=cfg.pad_token_id
+        )
+        attn_fn = partial(
+            ATTENTION[self.attention], axis_name="seq", causal=True
+        )
+        self._repl = NamedSharding(mesh, P())
+        self._batch = NamedSharding(mesh, P(("data",), ("seq",)))
+        # Dense-parameter twin used ONLY for init (identical pytree).
+        self._full = gpt_lm(cfg)
+        block_list = decoder_blocks(cfg, attn_fn)
+        if self.remat:
+            block_list = [L.remat(b) for b in block_list]
+        blocks = L.sequential(*block_list)
+        blocks_state = {str(i): {} for i in range(cfg.num_layers)}
+        drop = L.dropout(cfg.dropout_rate)
+        cdt = self.compute_dtype
+
+        def forward(params, ids, ctx):
+            """Per-shard forward: local ids (Bl, Tl) -> local logits.
+            The SAME stem/head math as the dense model (shared
+            `stem_apply`/`head_apply` from models/gpt.py), with the
+            position-embedding slice made shard-aware: it starts at this
+            shard's global offset (the dense stem would give shards
+            1..N-1 local-offset positions — `models/gpt.gpt_lm` doc)."""
+            tl = ids.shape[1]
+            s_idx = lax.axis_index("seq")
+            pos = lax.dynamic_slice_in_dim(
+                params["stem"]["position"], s_idx * tl, tl, axis=0
+            )
+            h, mask = lm_stem_apply(
+                params["stem"], ids, cfg, drop, ctx.child(0),
+                positions=pos,
+            )
+            (h, _), _ = blocks.apply(
+                params["blocks"], blocks_state, (h, mask), ctx.child(1)
+            )
+            return lm_head_apply(params["head"], h)
+
+        def local_sums(logits, targets):
+            """Per-shard metric SUMS over this shard's tokens — the
+            shared `_metrics` contract on the flattened token axis."""
+            b, tl, v = logits.shape
+            flat_logits = logits.reshape(b * tl, v)
+            flat_t = targets.reshape(b * tl)
+            return _metrics(
+                cross_entropy(flat_logits, flat_t), flat_logits, flat_t
+            )
+
+        def shard_step(ts: TrainState, ids, targets, lr):
+            rng = jax.random.fold_in(
+                jax.random.fold_in(
+                    jax.random.fold_in(jax.random.PRNGKey(0), ts.step),
+                    lax.axis_index("data"),
+                ),
+                lax.axis_index("seq"),
+            )
+            ctx = L.Context(train=True, rng=rng, dtype=cdt)
+
+            def loss_fn(params):
+                logits = forward(params, ids, ctx)
+                m = local_sums(logits, targets)
+                # LOCAL token-loss sum (pipeline discipline: no psum
+                # before grad).
+                return m["loss_sum"], m
+
+            (_, m), grads = jax.value_and_grad(loss_fn, has_aux=True)(
+                ts.params
+            )
+            n_global = lax.psum(m["count"], ("seq", "data"))
+            grads = jax.tree_util.tree_map(
+                lambda g: lax.psum(g, ("seq", "data"))
+                / jnp.maximum(n_global, 1.0),
+                grads,
+            )
+            params, opt_state = self.optimizer.update(
+                ts.params, ts.opt_state, grads, lr
+            )
+            new_ts = TrainState(
+                params, ts.model_state, opt_state, ts.step + 1
+            )
+            return new_ts, {
+                k: lax.psum(v, ("seq", "data")) for k, v in m.items()
+            }
+
+        def shard_eval(ts: TrainState, ids, targets):
+            logits = forward(
+                ts.params, ids, L.Context(train=False, dtype=cdt)
+            )
+            m = local_sums(logits, targets)
+            return {k: lax.psum(v, ("seq", "data")) for k, v in m.items()}
+
+        donate = (0,) if self.donate else ()
+        self.train_step = jax.jit(
+            shard_map(
+                shard_step, mesh=mesh,
+                in_specs=(
+                    P(), P(("data",), ("seq",)), P(("data",), ("seq",)),
+                    P(),
+                ),
+                out_specs=(P(), P()),
+                check_vma=False,
+            ),
+            donate_argnums=donate,
+        )
+        self.eval_step = jax.jit(
+            shard_map(
+                shard_eval, mesh=mesh,
+                in_specs=(
+                    P(), P(("data",), ("seq",)), P(("data",), ("seq",)),
+                ),
+                out_specs=P(),
+                check_vma=False,
+            )
+        )
+
+    def init_state(self, rng: jax.Array) -> TrainState:
+        params, model_state = self._full.init(rng)
+        opt_state = self.optimizer.init(params)
+        ts = TrainState(
+            params, model_state, opt_state, jnp.zeros((), jnp.int32)
+        )
+        return jax.device_put(ts, self._repl)
+
+    def shard_batch(self, ids, labels=None):
+        """ids (B, T) -> (ids, next-token targets), both sharded over
+        ('data', 'seq'). `labels` is ignored (the LM's targets are the
+        shifted ids); the parameter keeps the engine signature-uniform
+        with the classification engines."""
+        targets = self._lm_targets(ids)
+        ids_arr = _place_batch((ids,), self._batch)[0]
+        targets_arr = _place_batch((targets,), self._batch)[0]
+        return ids_arr, targets_arr
