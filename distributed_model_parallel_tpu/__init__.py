@@ -10,7 +10,7 @@ pipeline model parallelism (the reference's autograd-transparent
 `shard_map` with static shapes), tensor, sequence/context, and expert
 (MoE) parallelism, the model zoo (MobileNetV2 and variants, ResNet,
 BERT, a GPT-style causal LM, MoE transformer blocks), the dataset
-collection, and the trainer surface (SGD + cosine decay + linear warmup,
+collection, and the trainer surface (SGD / AdamW + cosine decay + warmup,
 acc1/acc5 metrics, best-acc checkpointing with resume, elastic
 restarts). Mechanics: INTERNALS.md; numbers: RESULTS.md.
 

@@ -54,6 +54,16 @@ STAGE_BUILDERS = {
 }
 
 
+def build_optimizer(args):
+    """--optimizer flag -> optimizer instance. --wd keeps its surface
+    meaning for both (decay strength); --momentum applies to sgd only."""
+    from distributed_model_parallel_tpu.training.optim import SGD, AdamW
+
+    if args.optimizer == "adamw":
+        return AdamW(weight_decay=args.weight_decay)
+    return SGD(momentum=args.momentum, weight_decay=args.weight_decay)
+
+
 def build_model(name: str, num_classes: int, *, remat: bool = False):
     if name not in MODELS:
         raise SystemExit(f"unknown model {name!r}; choose from {sorted(MODELS)}")
@@ -164,6 +174,11 @@ def add_common_tpu_flags(parser: argparse.ArgumentParser) -> None:
         "--remat", action="store_true",
         help="rematerialize activations during backward (jax.checkpoint) "
              "— trades compute for HBM on deep models",
+    )
+    parser.add_argument(
+        "--optimizer", default="sgd", choices=("sgd", "adamw"),
+        help="sgd = the reference's SGD(momentum, wd) surface; adamw = "
+             "decoupled-decay AdamW (the transformer-family convention)",
     )
     parser.add_argument(
         "--profile-dir", default=None,

@@ -28,6 +28,7 @@ from distributed_model_parallel_tpu.cli.common import (
     add_common_tpu_flags,
     build_loaders,
     build_model,
+    build_optimizer,
     check_batch_divisibility,
     compute_dtype_from_flag,
 )
@@ -37,7 +38,6 @@ from distributed_model_parallel_tpu.parallel.data_parallel import (
 )
 from distributed_model_parallel_tpu.runtime.dist import initialize_backend
 from distributed_model_parallel_tpu.runtime.mesh import MeshSpec, make_mesh
-from distributed_model_parallel_tpu.training.optim import SGD
 from distributed_model_parallel_tpu.training.trainer import (
     Trainer,
     TrainerConfig,
@@ -113,7 +113,7 @@ def main(argv=None) -> dict:
         workers=args.workers,
     )
     model = build_model(args.model, num_classes, remat=args.remat)
-    opt = SGD(momentum=args.momentum, weight_decay=args.weight_decay)
+    opt = build_optimizer(args)
     cdt = compute_dtype_from_flag(args.dtype)
     if args.engine == "ddp":
         engine = DDPEngine(

@@ -30,13 +30,13 @@ from distributed_model_parallel_tpu.cli.common import (
     STAGE_BUILDERS,
     add_common_tpu_flags,
     build_loaders,
+    build_optimizer,
     check_batch_divisibility,
     compute_dtype_from_flag,
 )
 from distributed_model_parallel_tpu.parallel.pipeline import PipelineEngine
 from distributed_model_parallel_tpu.runtime.dist import initialize_backend
 from distributed_model_parallel_tpu.runtime.mesh import MeshSpec, make_mesh
-from distributed_model_parallel_tpu.training.optim import SGD
 from distributed_model_parallel_tpu.training.trainer import (
     Trainer,
     TrainerConfig,
@@ -116,7 +116,7 @@ def main(argv=None) -> dict:
     )
     engine = PipelineEngine(
         stages,
-        SGD(momentum=args.momentum, weight_decay=args.weight_decay),
+        build_optimizer(args),
         mesh,
         num_microbatches=args.microbatches,
         compute_dtype=compute_dtype_from_flag(args.dtype),

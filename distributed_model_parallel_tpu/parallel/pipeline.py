@@ -297,28 +297,42 @@ class PipelineEngine:
 
     # ---------------------------------------------- checkpoint canonical
 
+    def _unpack_stages(self, flat_host, avals):
+        return tuple(
+            _unpack(flat_host[i], avals[i]) for i in range(self.num_stages)
+        )
+
     def to_canonical(self, ts: TrainState) -> TrainState:
         """TrainState in the layout-independent checkpoint form: params /
-        BN state / momentum as per-stage tuples of pytrees with real layer
-        paths and shapes. Checkpoints written this way are interchangeable
-        between stage_local_params modes (and validate per-layer structure
-        on restore, which a packed (S, maxP) leaf cannot)."""
+        BN state / optimizer buffers as per-stage tuples of pytrees with
+        real layer paths and shapes. Checkpoints written this way are
+        interchangeable between stage_local_params modes (and validate
+        per-layer structure on restore, which a packed (S, maxP) leaf
+        cannot).
+
+        Optimizer-state protocol: a NamedTuple whose fields are either
+        param-shaped buffers (packed (S, maxP) here — SGD momentum,
+        AdamW moments) or replicated scalars (AdamW's count); the walk
+        below keys on which shape each field carries."""
         if not self.stage_local_params:
             return ts
-        flat_m = _to_host(ts.opt_state.momentum)
-        momentum = tuple(
-            _unpack(flat_m[i], self._param_avals[i])
-            for i in range(self.num_stages)
+        packed_shape = (self.num_stages, self._psize)
+
+        def canon_opt_field(v):
+            if getattr(v, "shape", None) == packed_shape:
+                return self._unpack_stages(_to_host(v), self._param_avals)
+            return v
+
+        opt_c = type(ts.opt_state)(
+            **{
+                k: canon_opt_field(v)
+                for k, v in ts.opt_state._asdict().items()
+            }
         )
-        flat_s = _to_host(ts.model_state)
-        state = tuple(
-            _unpack(flat_s[i], self._state_avals[i])
-            for i in range(self.num_stages)
+        state = self._unpack_stages(
+            _to_host(ts.model_state), self._state_avals
         )
-        return TrainState(
-            self.params_tree(ts), state,
-            ts.opt_state._replace(momentum=momentum), ts.step,
-        )
+        return TrainState(self.params_tree(ts), state, opt_c, ts.step)
 
     def from_canonical(self, ts: TrainState) -> TrainState:
         """Inverse of `to_canonical`: re-pack a canonical TrainState into
@@ -331,11 +345,22 @@ class PipelineEngine:
         flat_s = self._stack_local(
             [_pack_np(s, self._ssize) for s in ts.model_state]
         )
-        flat_m = self._stack_local(
-            [_pack_np(m, self._psize) for m in ts.opt_state.momentum]
+
+        def pack_opt_field(v):
+            if isinstance(v, tuple) and len(v) == self.num_stages:
+                return self._stack_local(
+                    [_pack_np(m, self._psize) for m in v]
+                )
+            return jax.device_put(jnp.asarray(v), self._repl)
+
+        opt_p = type(ts.opt_state)(
+            **{
+                k: pack_opt_field(v)
+                for k, v in ts.opt_state._asdict().items()
+            }
         )
         return TrainState(
-            flat_p, flat_s, ts.opt_state._replace(momentum=flat_m),
+            flat_p, flat_s, opt_p,
             jax.device_put(jnp.asarray(ts.step), self._repl),
         )
 
@@ -543,10 +568,15 @@ class PipelineEngine:
 
         # shard_map spec for the TrainState: stage-local params ride the
         # 'stage' axis (each device gets its (1, maxP) slice); the
-        # replicated representation is a plain P() prefix.
+        # replicated representation is a plain P() prefix. The optimizer
+        # state's spec comes from the optimizer itself (state_shardings:
+        # param-shaped buffers follow the packed params, scalars like
+        # AdamW's step count stay replicated).
         if local:
             st = P(("stage",))
-            ts_spec = TrainState(st, st, st, P())
+            ts_spec = TrainState(
+                st, st, self.optimizer.state_shardings(st, P()), P()
+            )
         else:
             ts_spec = P()
 

@@ -157,9 +157,9 @@ class TensorParallelEngine:
         self._state_sh = TrainState(
             param_sh,
             jax.tree_util.tree_map(lambda _: self._repl, s_aval),
-            jax.eval_shape(self.optimizer.init, p_aval)._replace(
-                momentum=param_sh
-            ),
+            # Optimizer buffers shard exactly like their parameters
+            # (each optimizer declares its own state layout).
+            self.optimizer.state_shardings(param_sh, self._repl),
             self._repl,
         )
         sh = self._state_sh

@@ -1,6 +1,8 @@
 from distributed_model_parallel_tpu.training.optim import (  # noqa: F401
     SGD,
     SGDState,
+    AdamW,
+    AdamWState,
     cosine_warmup_schedule,
 )
 from distributed_model_parallel_tpu.training.metrics import (  # noqa: F401

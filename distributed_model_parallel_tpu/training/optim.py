@@ -54,6 +54,62 @@ class SGD:
         )
         return new_params, SGDState(new_buf)
 
+    def state_shardings(self, param_shardings, replicated):
+        """Opt-state sharding pytree given the params' sharding pytree —
+        the protocol the sharded engines (TP/EP) use to pin optimizer
+        buffers next to their parameters."""
+        return SGDState(param_shardings)
+
+
+class AdamWState(NamedTuple):
+    mu: Any     # first moment, pytree like params
+    nu: Any     # second moment, pytree like params
+    count: Any  # scalar int32 step count (bias correction)
+
+
+@dataclasses.dataclass(frozen=True)
+class AdamW:
+    """torch-semantics AdamW (decoupled weight decay, Loshchilov &
+    Hutter): moments in f32, `p -= lr * (m̂ / (sqrt(v̂) + eps) + wd·p)`.
+
+    Not in the reference (its optimizer surface is SGD+cosine), but the
+    transformer families (BERT/GPT/MoE) conventionally train with AdamW;
+    every engine takes it interchangeably with SGD (same init/update/
+    state_shardings protocol). Parity with `torch.optim.AdamW` is pinned
+    in tests/test_optim.py."""
+
+    beta1: float = 0.9
+    beta2: float = 0.999
+    eps: float = 1e-8
+    weight_decay: float = 1e-2
+
+    def init(self, params) -> AdamWState:
+        zeros = lambda: jax.tree_util.tree_map(jnp.zeros_like, params)
+        return AdamWState(zeros(), zeros(), jnp.zeros((), jnp.int32))
+
+    def update(self, params, opt_state: AdamWState, grads, lr):
+        b1, b2, eps, wd = self.beta1, self.beta2, self.eps, self.weight_decay
+        count = opt_state.count + 1
+        c1 = 1.0 - b1 ** count.astype(jnp.float32)
+        c2 = 1.0 - b2 ** count.astype(jnp.float32)
+        mu = jax.tree_util.tree_map(
+            lambda m, g: b1 * m + (1.0 - b1) * g, opt_state.mu, grads
+        )
+        nu = jax.tree_util.tree_map(
+            lambda v, g: b2 * v + (1.0 - b2) * jnp.square(g),
+            opt_state.nu, grads,
+        )
+        new_params = jax.tree_util.tree_map(
+            lambda p, m, v: p - lr * (
+                (m / c1) / (jnp.sqrt(v / c2) + eps) + wd * p
+            ),
+            params, mu, nu,
+        )
+        return new_params, AdamWState(mu, nu, count)
+
+    def state_shardings(self, param_shardings, replicated):
+        return AdamWState(param_shardings, param_shardings, replicated)
+
 
 def cosine_warmup_schedule(
     base_lr: float, t_max: int = 90, warmup_period: int = 10

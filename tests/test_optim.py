@@ -1,0 +1,164 @@
+"""Optimizer semantics: SGD/AdamW parity with their torch namesakes, and
+engine interchangeability (the optimizer protocol is duck-typed)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import torch
+
+from distributed_model_parallel_tpu.runtime.mesh import MeshSpec, make_mesh
+from distributed_model_parallel_tpu.training.optim import SGD, AdamW
+
+
+def _torch_run(opt_name, steps, lr, params0, grads_fn, **kw):
+    tparams = [
+        torch.nn.Parameter(torch.tensor(np.asarray(p))) for p in params0
+    ]
+    if opt_name == "sgd":
+        topt = torch.optim.SGD(tparams, lr=lr, **kw)
+    else:
+        topt = torch.optim.AdamW(tparams, lr=lr, **kw)
+    for s in range(steps):
+        topt.zero_grad()
+        for p, g in zip(tparams, grads_fn(s)):
+            p.grad = torch.tensor(np.asarray(g))
+        topt.step()
+    return [p.detach().numpy() for p in tparams]
+
+
+def _jax_run(opt, steps, lr, params0, grads_fn):
+    params = list(params0)
+    state = opt.init(params)
+    for s in range(steps):
+        params, state = opt.update(params, state, list(grads_fn(s)), lr)
+    return [np.asarray(p) for p in params]
+
+
+def _setup(seed=0):
+    rng = np.random.RandomState(seed)
+    params0 = [
+        jnp.asarray(rng.randn(4, 3).astype(np.float32)),
+        jnp.asarray(rng.randn(5).astype(np.float32)),
+    ]
+    gs = [
+        [jnp.asarray(rng.randn(*p.shape).astype(np.float32))
+         for p in params0]
+        for _ in range(5)
+    ]
+    return params0, lambda s: gs[s]
+
+
+def test_sgd_matches_torch():
+    params0, grads_fn = _setup()
+    got = _jax_run(
+        SGD(momentum=0.9, weight_decay=1e-4), 5, 0.1, params0, grads_fn
+    )
+    want = _torch_run(
+        "sgd", 5, 0.1, params0, grads_fn, momentum=0.9, weight_decay=1e-4
+    )
+    for g, w in zip(got, want):
+        np.testing.assert_allclose(g, w, rtol=1e-5, atol=1e-6)
+
+
+def test_adamw_matches_torch():
+    params0, grads_fn = _setup(1)
+    got = _jax_run(
+        AdamW(weight_decay=0.01), 5, 0.01, params0, grads_fn
+    )
+    want = _torch_run(
+        "adamw", 5, 0.01, params0, grads_fn,
+        betas=(0.9, 0.999), eps=1e-8, weight_decay=0.01,
+    )
+    for g, w in zip(got, want):
+        np.testing.assert_allclose(g, w, rtol=1e-5, atol=1e-6)
+
+
+def test_adamw_drives_every_engine_family():
+    """AdamW slots into a GSPMD engine and a sharded-state engine (TP)
+    via the shared init/update/state_shardings protocol."""
+    from distributed_model_parallel_tpu.models.bert import (
+        BertConfig,
+        bert_for_classification,
+    )
+    from distributed_model_parallel_tpu.models.tinycnn import tiny_cnn
+    from distributed_model_parallel_tpu.parallel.data_parallel import (
+        DataParallelEngine,
+    )
+    from distributed_model_parallel_tpu.parallel.tensor_parallel import (
+        TensorParallelEngine,
+    )
+
+    rng = np.random.RandomState(0)
+    mesh = make_mesh(MeshSpec(data=8))
+    eng = DataParallelEngine(tiny_cnn(10), AdamW(), mesh, donate=False)
+    ts = eng.init_state(jax.random.PRNGKey(0))
+    x = rng.rand(16, 8, 8, 3).astype(np.float32)
+    y = rng.randint(0, 10, size=(16,)).astype(np.int32)
+    losses = []
+    for _ in range(4):
+        ts, m = eng.train_step(
+            ts, *eng.shard_batch(x, y), jnp.float32(1e-3)
+        )
+        losses.append(float(m["loss_sum"]) / float(m["count"]))
+    assert losses[-1] < losses[0]
+
+    cfg = BertConfig(
+        vocab_size=67, hidden_size=32, num_layers=1, num_heads=4,
+        intermediate_size=64, max_position=8, dropout_rate=0.0,
+    )
+    tmesh = make_mesh(MeshSpec(data=2, model=4))
+    teng = TensorParallelEngine(
+        bert_for_classification(4, cfg), AdamW(), tmesh, donate=False
+    )
+    tts = teng.init_state(jax.random.PRNGKey(0))
+    ids = rng.randint(1, 67, size=(8, 8)).astype(np.int32)
+    labels = rng.randint(0, 4, size=(8,)).astype(np.int32)
+    tts, m = teng.train_step(
+        tts, *teng.shard_batch(ids, labels), jnp.float32(1e-3)
+    )
+    assert np.isfinite(float(m["loss_sum"]))
+    # AdamW moments shard like their params (state_shardings protocol)
+    qkv_mu = tts.opt_state.mu["blocks"]["0"]["attn"]["qkv"]["w"]
+    assert qkv_mu.addressable_shards[0].data.shape[1] == qkv_mu.shape[1] // 4
+
+
+def test_adamw_pipeline_stage_local_roundtrip():
+    """AdamW + stage-local pipeline params: the packed-state machinery
+    must shard param-shaped moments over 'stage', keep the scalar count
+    replicated, and round-trip through the canonical checkpoint form
+    (the combo the --optimizer flag makes reachable from the CLI)."""
+    from distributed_model_parallel_tpu.models import layers as L
+    from distributed_model_parallel_tpu.parallel.pipeline import (
+        PipelineEngine,
+    )
+
+    rng = np.random.RandomState(0)
+    stages = [
+        L.sequential(L.conv2d(3, 8, 3, stride=1, padding=1), L.relu()),
+        L.sequential(L.global_avg_pool(), L.linear(8, 10)),
+    ]
+    mesh = make_mesh(MeshSpec(data=4, stage=2))
+    eng = PipelineEngine(
+        stages, AdamW(), mesh, num_microbatches=2,
+        stage_local_params=True, donate=False,
+    )
+    ts = eng.init_state(jax.random.PRNGKey(0))
+    x = rng.rand(8, 8, 8, 3).astype(np.float32)
+    y = rng.randint(0, 10, size=(8,)).astype(np.int32)
+    losses = []
+    for _ in range(3):
+        ts, m = eng.train_step(
+            ts, *eng.shard_batch(x, y), jnp.float32(1e-3)
+        )
+        losses.append(float(m["loss_sum"]) / float(m["count"]))
+    assert losses[-1] < losses[0]
+    assert int(ts.opt_state.count) == 3  # replicated scalar survived
+
+    canon = eng.to_canonical(ts)
+    assert isinstance(canon.opt_state.mu, tuple) and len(canon.opt_state.mu) == 2
+    back = eng.from_canonical(canon)
+    ts2, m2 = eng.train_step(back, *eng.shard_batch(x, y), jnp.float32(1e-3))
+    ts1, m1 = eng.train_step(ts, *eng.shard_batch(x, y), jnp.float32(1e-3))
+    np.testing.assert_allclose(
+        float(m2["loss_sum"]), float(m1["loss_sum"]), rtol=1e-6
+    )
