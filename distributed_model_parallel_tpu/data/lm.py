@@ -1,0 +1,132 @@
+"""Language-model data: deterministic synthetic corpus + window loader.
+
+The reference has no text path at all; this module is the LM twin of
+`data/datasets.py`'s `synthetic`: a corpus CI can regenerate bit-for-bit
+with no downloads (this sandbox has zero egress), whose statistics make
+convergence measurable — tokens follow a fixed random first-order Markov
+chain, so the achievable cross-entropy floor is the chain's conditional
+entropy (reported by `chain_entropy`) and a model that learns the
+transition table shows a clear loss drop toward it.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+def _chain_tables(rng: np.random.RandomState, vocab_size: int,
+                  branching: int):
+    """The chain's (successor-ids, probs) tables, drawn from `rng` —
+    the SINGLE place the chain's RNG consumption order lives, shared by
+    `synthetic_corpus` and `chain_entropy` so they can never describe
+    two different chains."""
+    live = vocab_size - 1  # ids 1..vocab_size-1
+    succ = rng.randint(0, live, size=(live, branching))
+    probs = rng.dirichlet(np.ones(branching), size=live)
+    return succ, probs
+
+
+def _walk(succ, probs, walk_rng, num_tokens: int) -> np.ndarray:
+    out = np.empty(num_tokens, np.int32)
+    state = walk_rng.randint(0, succ.shape[0])
+    branching = succ.shape[1]
+    for i in range(num_tokens):
+        out[i] = state + 1
+        state = succ[state, walk_rng.choice(branching, p=probs[state])]
+    return out
+
+
+def synthetic_corpus(
+    vocab_size: int = 256,
+    num_tokens: int = 1 << 17,
+    seed: int = 0,
+    branching: int = 4,
+    stream_seed: int | None = None,
+) -> np.ndarray:
+    """A (num_tokens,) int32 token stream from a fixed random Markov
+    chain: each token has `branching` possible successors with a fixed
+    random distribution. Token id 0 is reserved (never emitted) so it
+    can serve as padding downstream.
+
+    `seed` fixes the CHAIN (transition table); `stream_seed` (default:
+    same as seed) fixes the sampled path through it — a val split is the
+    SAME chain walked with a different stream_seed, so train and val
+    measure one task."""
+    rng = np.random.RandomState(seed)
+    succ, probs = _chain_tables(rng, vocab_size, branching)
+    walk = (
+        rng if stream_seed is None else np.random.RandomState(stream_seed)
+    )
+    return _walk(succ, probs, walk, num_tokens)
+
+
+def chain_entropy(
+    vocab_size: int = 256, seed: int = 0, branching: int = 4,
+    num_sample_tokens: int = 1 << 15,
+) -> float:
+    """Entropy RATE (nats/token) of `synthetic_corpus`'s chain with the
+    same parameters — the cross-entropy floor a perfect next-token model
+    reaches on the stream.
+
+    Weighted by the EMPIRICAL state-visit distribution of a sample walk
+    (fixed internal seed), not a uniform average over states: the random
+    chain is generally not uniform-stationary and may be reducible, so
+    uniform weighting can sit above or below the floor the stream
+    actually exhibits."""
+    rng = np.random.RandomState(seed)
+    succ, probs = _chain_tables(rng, vocab_size, branching)
+    live = succ.shape[0]
+    ent = np.zeros(live)
+    for s in range(live):
+        # merge duplicate successors before the entropy sum
+        p = {}
+        for j in range(branching):
+            p[succ[s, j]] = p.get(succ[s, j], 0.0) + probs[s, j]
+        ent[s] = -sum(v * np.log(v) for v in p.values() if v > 0)
+    visits = np.bincount(
+        _walk(succ, probs, np.random.RandomState(0xC0FFEE),
+              num_sample_tokens) - 1,
+        minlength=live,
+    ).astype(np.float64)
+    return float(ent @ (visits / visits.sum()))
+
+
+class LMLoader:
+    """Batches of contiguous (batch, seq_len) windows from a token
+    stream, reshuffled per epoch (seeded — deterministic like the image
+    Loader). Yields (ids, ids): the second element fills the engines'
+    uniform (inputs, labels) slot; the causal-LM engines derive their
+    shifted targets themselves (`gpt.lm_targets`)."""
+
+    def __init__(self, corpus: np.ndarray, batch_size: int, seq_len: int,
+                 *, shuffle: bool = True, seed: int = 0):
+        self.corpus = np.asarray(corpus, np.int32)
+        self.batch_size = batch_size
+        self.seq_len = seq_len
+        self.shuffle = shuffle
+        self.seed = seed
+        self._epoch = 0
+        self.n_windows = len(self.corpus) // seq_len
+        if self.n_windows < batch_size:
+            raise ValueError(
+                f"corpus has {self.n_windows} windows of {seq_len} tokens "
+                f"but batch_size is {batch_size}"
+            )
+
+    def set_epoch(self, epoch: int) -> None:
+        self._epoch = epoch
+
+    def __len__(self) -> int:
+        return self.n_windows // self.batch_size
+
+    def __iter__(self):
+        order = np.arange(self.n_windows)
+        if self.shuffle:
+            np.random.RandomState(self.seed + self._epoch).shuffle(order)
+        for b in range(len(self)):
+            idx = order[b * self.batch_size:(b + 1) * self.batch_size]
+            ids = np.stack([
+                self.corpus[i * self.seq_len:(i + 1) * self.seq_len]
+                for i in idx
+            ])
+            yield ids, ids

@@ -71,7 +71,7 @@ class TrainState(NamedTuple):
 
     params: Any
     model_state: Any  # BN running stats
-    opt_state: SGDState
+    opt_state: Any  # optimizer NamedTuple (SGDState / AdamWState)
     step: jax.Array
 
 
@@ -121,7 +121,7 @@ class DataParallelEngine:
     collectives inserted by the XLA SPMD partitioner."""
 
     model: Layer
-    optimizer: SGD
+    optimizer: Any  # SGD | AdamW (init/update/state_shardings protocol)
     mesh: Mesh
     donate: bool = True
     # Mixed precision: activations/compute in this dtype (e.g. jnp.bfloat16
@@ -217,7 +217,7 @@ class DDPEngine:
     """
 
     model: Layer
-    optimizer: SGD
+    optimizer: Any  # SGD | AdamW (init/update/state_shardings protocol)
     mesh: Mesh
     sync_bn: bool = False
     donate: bool = True

@@ -165,7 +165,7 @@ class PipelineEngine:
     pipeline (bubble fraction (S-1)/(M+S-1))."""
 
     stages: List[Layer]
-    optimizer: SGD
+    optimizer: Any  # SGD | AdamW (init/update/state_shardings protocol)
     mesh: Mesh
     num_microbatches: int = 1
     sync_bn: bool = False
@@ -266,7 +266,14 @@ class PipelineEngine:
             del p, s
         flat_p = self._stack_local(p_rows)
         flat_s = self._stack_local(s_rows)
-        opt_state = self.optimizer.init(flat_p)  # zeros_like keeps sharding
+        # zeros_like keeps the 'stage' sharding for param-shaped buffers;
+        # scalar fields (AdamW's count) come back process-local and must
+        # be placed on the mesh like `step` below — state_shardings says
+        # which is which.
+        opt_state = jax.device_put(
+            self.optimizer.init(flat_p),
+            self.optimizer.state_shardings(self._stage_sh, self._repl),
+        )
         return TrainState(
             flat_p, flat_s, opt_state,
             jax.device_put(jnp.zeros((), jnp.int32), self._repl),

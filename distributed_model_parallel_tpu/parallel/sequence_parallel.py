@@ -72,7 +72,7 @@ class SequenceParallelEngine:
 
     cfg: BertConfig
     num_classes: int
-    optimizer: SGD
+    optimizer: Any  # SGD | AdamW (init/update/state_shardings protocol)
     mesh: Mesh
     attention: str = "ring"
     donate: bool = True
@@ -250,7 +250,7 @@ class CausalLMSequenceParallelEngine:
     triangular (`ops/ring_attention.py`)."""
 
     cfg: Any  # models.gpt.GPTConfig
-    optimizer: SGD
+    optimizer: Any  # SGD | AdamW (init/update/state_shardings protocol)
     mesh: Mesh
     attention: str = "ring"
     donate: bool = True

@@ -84,7 +84,7 @@ class TensorParallelEngine:
     eval_step / shard_batch / init_state)."""
 
     model: Layer
-    optimizer: SGD
+    optimizer: Any  # SGD | AdamW (init/update/state_shardings protocol)
     mesh: Mesh
     rules: Sequence[Tuple[str, P]] = MEGATRON_RULES
     donate: bool = True

@@ -1,6 +1,7 @@
 """Causal LM (GPT-style decoder) tests, including the data-parallel
 training recipe and the flash/ring attention_fn swaps."""
 
+import os
 from functools import partial
 
 import jax
@@ -244,3 +245,53 @@ def test_lm_targets_shift_and_padding():
     np.testing.assert_array_equal(t, [[6, 7, -1, -1]])
     t2 = lm_targets(ids)  # no padding semantics
     np.testing.assert_array_equal(t2, [[6, 7, 0, -1]])
+
+
+def test_lm_corpus_and_loader_deterministic():
+    from distributed_model_parallel_tpu.data.lm import (
+        LMLoader,
+        chain_entropy,
+        synthetic_corpus,
+    )
+
+    c1 = synthetic_corpus(64, 4096, seed=3)
+    c2 = synthetic_corpus(64, 4096, seed=3)
+    np.testing.assert_array_equal(c1, c2)
+    assert c1.min() >= 1  # id 0 reserved for padding
+    # same chain, different walk: a different stream over the SAME
+    # transition support (that's what makes it a usable val split)
+    cv = synthetic_corpus(64, 4096, seed=3, stream_seed=99)
+    assert not np.array_equal(c1, cv)
+    bigrams = lambda c: {(a, b) for a, b in zip(c[:-1], c[1:])}
+    novel = bigrams(cv) - bigrams(c1)
+    assert len(novel) / len(bigrams(cv)) < 0.2
+    floor = chain_entropy(64, seed=3)
+    assert 0.5 < floor < np.log(4) + 0.01  # branching=4 bounds it
+    ld = LMLoader(c1, batch_size=4, seq_len=32, seed=0)
+    ld.set_epoch(1)
+    a = [ids.copy() for ids, _ in ld]
+    ld2 = LMLoader(c1, batch_size=4, seq_len=32, seed=0)
+    ld2.set_epoch(1)
+    b = [ids.copy() for ids, _ in ld2]
+    for x, y in zip(a, b):
+        np.testing.assert_array_equal(x, y)
+    assert len(a) == len(ld) == 32
+
+
+def test_lm_cli_smoke(tmp_path, monkeypatch):
+    """The LM pretraining entry point runs end to end (seq-sharded mesh,
+    AdamW, Markov corpus) and the loss moves toward the printed floor."""
+    monkeypatch.chdir(tmp_path)
+    from distributed_model_parallel_tpu.cli.lm import main
+
+    res = main([
+        "--vocab-size", "64", "--dim", "32", "--layers", "1",
+        "--heads", "4", "--seq-len", "32", "-b", "8",
+        "--epochs", "2", "--steps-per-epoch", "6", "--lr", "3e-3",
+        "--seq-shards", "4", "--corpus-tokens", str(1 << 13),
+        "--log-file", "lm.txt",
+    ])
+    assert len(res["history"]) == 2
+    h = res["history"]
+    assert h[-1]["train"]["loss"] < h[0]["train"]["loss"]
+    assert os.path.isfile(tmp_path / "log" / "lm.txt")
