@@ -74,7 +74,9 @@ def build_parser() -> argparse.ArgumentParser:
                    help="'seq' mesh axis size (context parallelism); "
                         "1 = plain data parallelism")
     p.add_argument("--attention", default="ring",
-                   choices=("ring", "ulysses"))
+                   choices=("ring", "ring_flash", "ulysses"),
+                   help="ring_flash = Pallas kernels per ring hop (the "
+                        "long-context hot path on TPU)")
     p.add_argument("--dtype", default="float32",
                    choices=("float32", "bfloat16"))
     p.add_argument("--remat", action="store_true")

@@ -1,8 +1,9 @@
 """Compute ops: attention cores (reference-free — the reference has no
 attention model; BERT-base is demanded by BASELINE.json's configs), their
 sequence-parallel variants (ring attention over ppermute, Ulysses
-all-to-all), and the Pallas flash-attention forward kernel for the
-single-chip hot path."""
+all-to-all, and ring_flash_attention — the ring with the fused Pallas
+kernels as its per-hop core), and the Pallas flash-attention kernels
+(forward + backward) for the single-chip hot path."""
 
 from distributed_model_parallel_tpu.ops.attention import (  # noqa: F401
     dot_product_attention,
@@ -12,5 +13,6 @@ from distributed_model_parallel_tpu.ops.pallas_attention import (  # noqa: F401
 )
 from distributed_model_parallel_tpu.ops.ring_attention import (  # noqa: F401
     ring_attention,
+    ring_flash_attention,
     ulysses_attention,
 )

@@ -63,6 +63,10 @@ from distributed_model_parallel_tpu.ops.attention import (
 
 _NEG = jnp.finfo(jnp.float32).min
 _LANES = 128  # lane-broadcast width for per-row stats (see module doc)
+# v5e-tuned default tiles (see flash_attention docstring); shared with
+# the ring_flash per-hop dispatch so a retune applies everywhere.
+DEFAULT_BLOCK_Q = 512
+DEFAULT_BLOCK_K = 1024
 
 
 def _mask_window(mask_ref, ki: int, bk: int):
@@ -519,8 +523,8 @@ def flash_attention(
     *,
     scale: Optional[float] = None,
     causal: bool = False,
-    block_q: int = 512,
-    block_k: int = 1024,
+    block_q: int = DEFAULT_BLOCK_Q,
+    block_k: int = DEFAULT_BLOCK_K,
     interpret: Optional[bool] = None,
 ) -> jax.Array:
     """Drop-in `attention_fn` backed by the Pallas flash kernels.

@@ -47,6 +47,7 @@ from distributed_model_parallel_tpu.models.bert import (
 )
 from distributed_model_parallel_tpu.ops.ring_attention import (
     ring_attention,
+    ring_flash_attention,
     ulysses_attention,
 )
 from distributed_model_parallel_tpu.parallel.data_parallel import (
@@ -57,7 +58,11 @@ from distributed_model_parallel_tpu.parallel.data_parallel import (
 from distributed_model_parallel_tpu.training.metrics import cross_entropy
 from distributed_model_parallel_tpu.training.optim import SGD
 
-ATTENTION = {"ring": ring_attention, "ulysses": ulysses_attention}
+ATTENTION = {
+    "ring": ring_attention,
+    "ring_flash": ring_flash_attention,  # Pallas kernels per hop
+    "ulysses": ulysses_attention,
+}
 
 
 @dataclasses.dataclass

@@ -317,3 +317,55 @@ def test_rules_require_expert_axis():
     )
     with pytest.raises(ValueError, match="expert"):
         ExpertParallelEngine(_moe_classifier(4), SGD(), flat, donate=False)
+
+
+def test_tp_ep_dp_compose_on_one_mesh():
+    """Tensor, expert, and data parallelism in ONE jit program: a
+    (data=2, model=2, expert=2) mesh with MEGATRON_RULES + EXPERT_RULES
+    concatenated. Attention/FFN weights shard over 'model', expert
+    stacks over 'expert', batch over 'data' — and the trajectory still
+    matches plain 8-way DP."""
+    from distributed_model_parallel_tpu.models.bert import (
+        BertConfig,
+        bert_for_classification,
+    )
+    from distributed_model_parallel_tpu.parallel.tensor_parallel import (
+        MEGATRON_RULES,
+        TensorParallelEngine,
+    )
+
+    cfg = BertConfig(
+        vocab_size=67, hidden_size=32, num_layers=2, num_heads=4,
+        intermediate_size=64, max_position=16, dropout_rate=0.0,
+        num_experts=2, moe_every=2,
+    )
+    model = bert_for_classification(4, cfg)
+    mesh3 = make_mesh(MeshSpec(data=2, model=2, expert=2))
+    eng3 = ExpertParallelEngine(
+        model, SGD(), mesh3, rules=EXPERT_RULES + MEGATRON_RULES,
+        donate=False,
+    )
+    dp = DataParallelEngine(
+        model, SGD(), make_mesh(MeshSpec(data=8)), donate=False
+    )
+    rng = np.random.RandomState(0)
+    ids = rng.randint(1, 67, size=(8, 16)).astype(np.int32)
+    labels = rng.randint(0, 4, size=(8,)).astype(np.int32)
+
+    def run(eng):
+        ts = eng.init_state(jax.random.PRNGKey(0))
+        i, l = eng.shard_batch(ids, labels)
+        losses = []
+        for _ in range(3):
+            ts, m = eng.train_step(ts, i, l, jnp.float32(0.05))
+            losses.append(float(m["loss_sum"]) / float(m["count"]))
+        return ts, losses
+
+    ts3, l3 = run(eng3)
+    _, ldp = run(dp)
+    np.testing.assert_allclose(l3, ldp, rtol=1e-4)
+    # physically: qkv sharded over 'model', experts over 'expert'
+    qkv = ts3.params["blocks"]["0"]["attn"]["qkv"]["w"]
+    assert qkv.addressable_shards[0].data.shape[1] == qkv.shape[1] // 2
+    w_in = ts3.params["blocks"]["1"]["moe"]["experts"]["w_in"]
+    assert w_in.addressable_shards[0].data.shape[0] == w_in.shape[0] // 2

@@ -334,3 +334,138 @@ def test_causal_flash_matches_dense():
     np.testing.assert_allclose(
         np.asarray(g1), np.asarray(g2), rtol=2e-4, atol=2e-5
     )
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_ring_flash_matches_full_attention(sp_mesh, causal):
+    """ring_flash_attention (per-hop flash kernels + LSE merge) must
+    equal dense attention — forward and all three gradients, with a
+    padding mask, causal and not. On the CI mesh the tiny blocks take
+    the dense per-hop fallback; the merge/rotation logic is identical."""
+    from distributed_model_parallel_tpu.ops.ring_attention import (
+        ring_flash_attention,
+    )
+
+    q, k, v, mask = _qkv(seed=21)
+    spec = P(None, ("seq",))
+    sharded = jax.jit(
+        shard_map(
+            partial(ring_flash_attention, axis_name="seq", causal=causal),
+            mesh=sp_mesh,
+            in_specs=(spec, spec, spec, P(None, ("seq",))),
+            out_specs=spec,
+            check_vma=False,
+        )
+    )
+    want = dot_product_attention(q, k, v, mask, causal=causal)
+    got = sharded(q, k, v, mask)
+    np.testing.assert_allclose(
+        np.asarray(got), np.asarray(want), rtol=1e-5, atol=1e-5
+    )
+
+    def loss_sharded(q, k, v):
+        return jnp.sum(jnp.square(sharded(q, k, v, mask)))
+
+    def loss_dense(q, k, v):
+        return jnp.sum(jnp.square(
+            dot_product_attention(q, k, v, mask, causal=causal)
+        ))
+
+    got_g = jax.grad(loss_sharded, argnums=(0, 1, 2))(q, k, v)
+    want_g = jax.grad(loss_dense, argnums=(0, 1, 2))(q, k, v)
+    for gg, wg, name in zip(got_g, want_g, "qkv"):
+        np.testing.assert_allclose(
+            np.asarray(gg), np.asarray(wg), rtol=2e-4, atol=2e-5,
+            err_msg=f"grad wrt {name} (causal={causal})",
+        )
+
+
+def test_ring_flash_no_mask(sp_mesh):
+    from distributed_model_parallel_tpu.ops.ring_attention import (
+        ring_flash_attention,
+    )
+
+    q, k, v, _ = _qkv(seed=22)
+    spec = P(None, ("seq",))
+    sharded = jax.jit(
+        shard_map(
+            partial(ring_flash_attention, axis_name="seq"),
+            mesh=sp_mesh,
+            in_specs=(spec, spec, spec),
+            out_specs=spec,
+            check_vma=False,
+        )
+    )
+    want = dot_product_attention(q, k, v)
+    got = sharded(q, k, v)
+    np.testing.assert_allclose(
+        np.asarray(got), np.asarray(want), rtol=1e-5, atol=1e-5
+    )
+    g = jax.grad(
+        lambda k: jnp.sum(jnp.square(sharded(q, k, v)))
+    )(k)
+    gw = jax.grad(
+        lambda k: jnp.sum(jnp.square(dot_product_attention(q, k, v)))
+    )(k)
+    np.testing.assert_allclose(
+        np.asarray(g), np.asarray(gw), rtol=2e-4, atol=2e-5
+    )
+
+
+def test_ring_flash_kernel_path_multihop(sp_mesh):
+    """Shapes large enough that every hop runs the PALLAS kernels
+    (interpret mode here): the LSE merge and the rotating dk/dv
+    delivery are exercised with the production per-hop core, not the
+    dense fallback."""
+    from distributed_model_parallel_tpu.ops.ring_attention import (
+        ring_flash_attention,
+    )
+
+    b, t, h, dh = 1, 512, 2, 16  # Tl = 128 per shard -> kernel path
+    rng = np.random.RandomState(0)
+    mk = lambda: jnp.asarray(rng.randn(b, t, h, dh).astype(np.float32))
+    q, k, v = mk(), mk(), mk()
+    spec = P(None, ("seq",))
+    f = jax.jit(shard_map(
+        partial(ring_flash_attention, axis_name="seq", causal=True),
+        mesh=sp_mesh, in_specs=(spec,) * 3, out_specs=spec,
+        check_vma=False,
+    ))
+    want = dot_product_attention(q, k, v, causal=True)
+    np.testing.assert_allclose(
+        np.asarray(f(q, k, v)), np.asarray(want), rtol=2e-5, atol=2e-5
+    )
+    g = jax.grad(lambda k: jnp.sum(f(q, k, v) ** 2))(k)
+    gw = jax.grad(
+        lambda k: jnp.sum(dot_product_attention(q, k, v, causal=True) ** 2)
+    )(k)
+    np.testing.assert_allclose(
+        np.asarray(g), np.asarray(gw), rtol=2e-4, atol=2e-5
+    )
+
+
+def test_lm_engine_ring_flash_trains():
+    """attention='ring_flash' drops into the causal-LM engine."""
+    from distributed_model_parallel_tpu.models.gpt import GPTConfig
+    from distributed_model_parallel_tpu.parallel.sequence_parallel import (
+        CausalLMSequenceParallelEngine,
+    )
+    from distributed_model_parallel_tpu.training.optim import SGD
+
+    cfg = GPTConfig(
+        vocab_size=61, dim=32, num_layers=1, num_heads=4, ffn_dim=64,
+        max_position=16, dropout_rate=0.0,
+    )
+    mesh = make_mesh(MeshSpec(data=2, seq=4))
+    eng = CausalLMSequenceParallelEngine(
+        cfg, SGD(), mesh, attention="ring_flash", donate=False
+    )
+    ts = eng.init_state(jax.random.PRNGKey(0))
+    rng = np.random.RandomState(1)
+    ids = rng.randint(1, 61, size=(8, 16)).astype(np.int32)
+    i, t = eng.shard_batch(ids)
+    losses = []
+    for _ in range(4):
+        ts, m = eng.train_step(ts, i, t, jnp.float32(0.3))
+        losses.append(float(m["loss_sum"]) / float(m["count"]))
+    assert losses[-1] < losses[0]
