@@ -19,8 +19,8 @@ Package layout:
   models/    pure-functional model zoo (param/state pytrees, NHWC)
   ops/       attention cores: XLA, ring / Ulysses sequence-parallel,
              Pallas flash kernel
-  parallel/  DP / DDP / pipeline / tensor-parallel / sequence-parallel /
-             expert-parallel engines
+  parallel/  DP / DDP / FSDP / pipeline / tensor-parallel /
+             sequence-parallel / expert-parallel engines
   data/      dataset collection + per-host sharded, prefetching input
              pipeline
   training/  trainer loops, optimizer/schedule, metrics, checkpointing,

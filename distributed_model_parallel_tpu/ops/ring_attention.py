@@ -24,12 +24,16 @@ of this framework's capability surface.
   ring's O((T/N)²) logits tile per hop. The distributed long-context
   hot path.
 
-Both compute in f32 and cast back to the input dtype (bf16-safe), match
-`dot_product_attention` numerically (tests/test_sequence_parallel.py,
-forward AND gradients), support the (B, Tkv) key-validity mask, and take
-`causal=True` for decoder-style models (the ring applies it as a
-block-index predicate on the rotating KV blocks; Ulysses applies the
-ordinary triangle after its all-to-all).
+All three match `dot_product_attention` numerically
+(tests/test_sequence_parallel.py, forward AND gradients), support the
+(B, Tkv) key-validity mask, and take `causal=True` for decoder-style
+models (the rings apply it as a block-index predicate on the rotating
+KV blocks; Ulysses applies the ordinary triangle after its all-to-all).
+Precision: ring/ulysses accumulate in f32 end to end and cast back to
+the input dtype; ring_flash's kernel path follows the flash kernel's
+contract (f32 softmax/accumulators in VMEM, per-hop partial outputs
+rounded to the input dtype before the f32 log-sum-exp merge — the bf16
+tolerance tests cover this).
 """
 
 from __future__ import annotations

@@ -18,3 +18,6 @@ from distributed_model_parallel_tpu.parallel.expert_parallel import (  # noqa: F
     EXPERT_RULES,
     ExpertParallelEngine,
 )
+from distributed_model_parallel_tpu.parallel.fsdp import (  # noqa: F401
+    FSDPEngine,
+)

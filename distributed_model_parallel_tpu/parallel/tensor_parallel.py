@@ -151,7 +151,7 @@ class TensorParallelEngine:
         p_aval, s_aval = jax.eval_shape(self.model.init, key_aval)
         param_sh = jax.tree_util.tree_map(
             lambda spec: NamedSharding(mesh, spec),
-            shard_specs(p_aval, self.rules),
+            self.param_specs(p_aval),
             is_leaf=lambda x: isinstance(x, P),
         )
         self._state_sh = TrainState(
@@ -175,6 +175,11 @@ class TensorParallelEngine:
             in_shardings=(sh, self._batch, self._batch),
             out_shardings=self._repl,
         )
+
+    def param_specs(self, p_aval):
+        """PartitionSpec pytree for the parameters — rule-driven here;
+        subclasses (FSDPEngine) override with shape-driven policies."""
+        return shard_specs(p_aval, self.rules)
 
     def init_state(self, rng: jax.Array) -> TrainState:
         params, model_state = self.model.init(rng)

@@ -425,19 +425,27 @@ def test_ring_flash_kernel_path_multihop(sp_mesh):
     rng = np.random.RandomState(0)
     mk = lambda: jnp.asarray(rng.randn(b, t, h, dh).astype(np.float32))
     q, k, v = mk(), mk(), mk()
+    # A mask exercises the kernel path's per-hop mask rotation, the
+    # whole-mask BlockSpec, and the +/-inf LSE sentinel conversion.
+    mask = jnp.asarray(rng.rand(b, t) > 0.2).at[:, 0].set(True)
     spec = P(None, ("seq",))
     f = jax.jit(shard_map(
         partial(ring_flash_attention, axis_name="seq", causal=True),
-        mesh=sp_mesh, in_specs=(spec,) * 3, out_specs=spec,
+        mesh=sp_mesh,
+        in_specs=(spec, spec, spec, P(None, ("seq",))),
+        out_specs=spec,
         check_vma=False,
     ))
-    want = dot_product_attention(q, k, v, causal=True)
+    want = dot_product_attention(q, k, v, mask, causal=True)
     np.testing.assert_allclose(
-        np.asarray(f(q, k, v)), np.asarray(want), rtol=2e-5, atol=2e-5
+        np.asarray(f(q, k, v, mask)), np.asarray(want),
+        rtol=2e-5, atol=2e-5,
     )
-    g = jax.grad(lambda k: jnp.sum(f(q, k, v) ** 2))(k)
+    g = jax.grad(lambda k: jnp.sum(f(q, k, v, mask) ** 2))(k)
     gw = jax.grad(
-        lambda k: jnp.sum(dot_product_attention(q, k, v, causal=True) ** 2)
+        lambda k: jnp.sum(
+            dot_product_attention(q, k, v, mask, causal=True) ** 2
+        )
     )(k)
     np.testing.assert_allclose(
         np.asarray(g), np.asarray(gw), rtol=2e-4, atol=2e-5
