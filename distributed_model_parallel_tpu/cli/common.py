@@ -25,6 +25,7 @@ from distributed_model_parallel_tpu.models import (
     resnet50,
     tiny_cnn,
     tinycnn,
+    vit_cifar,
 )
 
 MODELS = {
@@ -33,6 +34,7 @@ MODELS = {
     "resnet18": resnet18,
     "resnet50": resnet50,
     "tinycnn": tiny_cnn,
+    "vit": vit_cifar,  # CIFAR-scale ViT (32^2 inputs, 4x4 patches)
 }
 
 # Pipeline stage builders, kept beside MODELS so both CLIs extend in one

@@ -31,3 +31,11 @@ from distributed_model_parallel_tpu.models.moe import (  # noqa: F401
     moe_encoder_layer,
     moe_feed_forward,
 )
+from distributed_model_parallel_tpu.models.vit import (  # noqa: F401
+    VIT_B16,
+    VIT_CIFAR,
+    ViTConfig,
+    vit,
+    vit_b16,
+    vit_cifar,
+)

@@ -1,0 +1,218 @@
+"""Vision Transformer family (pre-LN, torchvision-convention).
+
+Not in the reference (its zoo is MobileNetV2 ±BN); it exists here
+because the framework's transformer machinery makes the modern vision
+baseline nearly free: patchify = one strided conv, then the SAME
+attention/FFN primitives as BERT/GPT (`models/transformer.py`) in
+pre-LN arrangement — so the Megatron TP rules, the flash attention
+kernel, FSDP, and per-block remat all apply to ViT unchanged.
+
+Conventions match `torchvision.models.vision_transformer` so parity is
+checkable against its published parameter counts: learned class token,
+learned position embeddings over (1 + HW/P²) tokens, pre-LN encoder
+blocks (h += Attn(LN(h)); h += MLP(LN(h))), final LayerNorm, linear
+head on the class token. `vit_b16(1000)` matches torchvision
+`vit_b_16`'s 86,567,656 parameters exactly (tests/test_vit.py).
+
+Input: NHWC images; output: (B, num_classes) logits — a standard
+`Layer`, so every engine (DP/DDP/FSDP/TP via MEGATRON_RULES) drives it
+like the CNN zoo.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+
+from distributed_model_parallel_tpu.models import layers as L
+from distributed_model_parallel_tpu.models.transformer import (
+    AttentionFn,
+    feed_forward,
+    multi_head_attention,
+)
+from distributed_model_parallel_tpu.ops.attention import dot_product_attention
+
+
+@dataclasses.dataclass(frozen=True)
+class ViTConfig:
+    image_size: int = 224
+    patch_size: int = 16
+    dim: int = 768
+    num_layers: int = 12
+    num_heads: int = 12
+    mlp_dim: int = 3072
+    dropout_rate: float = 0.0
+    layer_norm_eps: float = 1e-6
+
+    @property
+    def num_patches(self) -> int:
+        if self.image_size % self.patch_size:
+            raise ValueError(
+                f"image_size {self.image_size} not divisible by "
+                f"patch_size {self.patch_size}"
+            )
+        return (self.image_size // self.patch_size) ** 2
+
+
+VIT_B16 = ViTConfig()
+# CIFAR-scale variant: 32² images, 4×4 patches (64 tokens).
+VIT_CIFAR = ViTConfig(
+    image_size=32, patch_size=4, dim=192, num_layers=6, num_heads=6,
+    mlp_dim=768,
+)
+
+
+def pre_ln_encoder_layer(
+    dim: int,
+    num_heads: int,
+    mlp_dim: int,
+    *,
+    dropout_rate: float = 0.0,
+    eps: float = 1e-6,
+    attention_fn: AttentionFn = dot_product_attention,
+) -> L.Layer:
+    """Pre-LN block on the (hidden, mask) pair:
+    h += Attn(LN(h)); h += MLP(LN(h)). The transformer primitives are
+    shared with the BERT/GPT (post-LN) stack, so Megatron TP rules
+    (attn/qkv, attn/out, ffn/in, ffn/out paths) match unchanged."""
+    attn = multi_head_attention(
+        dim, num_heads, dropout_rate=dropout_rate, attention_fn=attention_fn
+    )
+    ffn = feed_forward(dim, mlp_dim, dropout_rate=dropout_rate)
+    ln1 = L.layernorm(dim, eps=eps)
+    ln2 = L.layernorm(dim, eps=eps)
+
+    def init(key):
+        ka, kf, k1, k2 = jax.random.split(key, 4)
+        return (
+            {
+                "ln1": ln1.init(k1)[0],
+                "attn": attn.init(ka)[0],
+                "ln2": ln2.init(k2)[0],
+                "ffn": ffn.init(kf)[0],
+            },
+            {},
+        )
+
+    def apply(params, state, x, ctx):
+        h, mask = x
+        hn, _ = ln1.apply(params["ln1"], {}, h, ctx)
+        (a, _), _ = attn.apply(params["attn"], {}, (hn, mask), ctx.child(0))
+        h = h + a
+        hn, _ = ln2.apply(params["ln2"], {}, h, ctx)
+        (f, _), _ = ffn.apply(params["ffn"], {}, (hn, mask), ctx.child(1))
+        return (h + f, mask), state
+
+    return L.Layer(init, apply)
+
+
+def _vit_stem(cfg: ViTConfig) -> L.Layer:
+    """Patchify conv + class token + position embeddings + dropout:
+    NHWC (B, S, S, 3) -> ((B, 1+N, D) tokens, None mask)."""
+    drop = L.dropout(cfg.dropout_rate)
+    n_tokens = cfg.num_patches + 1
+
+    def init(key):
+        kc, kt, kp = jax.random.split(key, 3)
+        fan_in = 3 * cfg.patch_size * cfg.patch_size
+        return {
+            "proj": {
+                # torchvision init: trunc-normal-ish conv; exact init
+                # statistics are not part of the parity contract.
+                "w": jax.random.normal(
+                    kc,
+                    (cfg.patch_size, cfg.patch_size, 3, cfg.dim),
+                ) * (fan_in ** -0.5),
+                "b": jnp.zeros((cfg.dim,)),
+            },
+            "cls": 0.02 * jax.random.normal(kt, (1, 1, cfg.dim)),
+            "position": 0.02 * jax.random.normal(
+                kp, (1, n_tokens, cfg.dim)
+            ),
+        }, {}
+
+    def apply(params, state, images, ctx):
+        if images.shape[1:3] != (cfg.image_size, cfg.image_size):
+            # Fail with an actionable message at trace time, not with an
+            # opaque broadcast error against the position table.
+            raise ValueError(
+                f"ViT configured for {cfg.image_size}x{cfg.image_size} "
+                f"inputs (patch {cfg.patch_size}) got images of shape "
+                f"{images.shape}; pick a matching ViTConfig/dataset"
+            )
+        x = images
+        if ctx.dtype is not None:
+            x = x.astype(ctx.dtype)
+        p = jax.lax.conv_general_dilated(
+            x, params["proj"]["w"].astype(x.dtype),
+            window_strides=(cfg.patch_size, cfg.patch_size),
+            padding="VALID",
+            dimension_numbers=("NHWC", "HWIO", "NHWC"),
+        ) + params["proj"]["b"].astype(x.dtype)
+        b = p.shape[0]
+        tokens = p.reshape(b, -1, cfg.dim)  # (B, N, D), row-major patches
+        cls = jnp.broadcast_to(
+            params["cls"].astype(tokens.dtype), (b, 1, cfg.dim)
+        )
+        h = jnp.concatenate([cls, tokens], axis=1)
+        h = h + params["position"].astype(h.dtype)
+        h, _ = drop.apply({}, {}, h, ctx)
+        return (h, None), state
+
+    return L.Layer(init, apply)
+
+
+def _vit_head(cfg: ViTConfig, num_classes: int) -> L.Layer:
+    ln = L.layernorm(cfg.dim, eps=cfg.layer_norm_eps)
+    linear = L.linear(cfg.dim, num_classes)
+
+    def init(key):
+        kl, kh = jax.random.split(key)
+        return {"ln": ln.init(kl)[0], "fc": linear.init(kh)[0]}, {}
+
+    def apply(params, state, x, ctx):
+        h, _ = x
+        hn, _ = ln.apply(params["ln"], {}, h, ctx)
+        logits, _ = linear.apply(params["fc"], {}, hn[:, 0, :], ctx)
+        return logits, state
+
+    return L.Layer(init, apply)
+
+
+def vit(
+    num_classes: int,
+    cfg: ViTConfig = VIT_B16,
+    *,
+    attention_fn: AttentionFn = dot_product_attention,
+    remat: bool = False,
+) -> L.Layer:
+    """Full classifier: NHWC images -> (B, num_classes) logits.
+    `remat=True` checkpoints each encoder block."""
+    blocks = [
+        pre_ln_encoder_layer(
+            cfg.dim, cfg.num_heads, cfg.mlp_dim,
+            dropout_rate=cfg.dropout_rate, eps=cfg.layer_norm_eps,
+            attention_fn=attention_fn,
+        )
+        for _ in range(cfg.num_layers)
+    ]
+    if remat:
+        blocks = [L.remat(b) for b in blocks]
+    return L.named([
+        ("stem", _vit_stem(cfg)),
+        ("blocks", L.sequential(*blocks)),
+        ("head", _vit_head(cfg, num_classes)),
+    ])
+
+
+def vit_b16(num_classes: int = 1000, **kw) -> L.Layer:
+    """ViT-B/16 (torchvision `vit_b_16` layout: 86,567,656 params at
+    1000 classes)."""
+    return vit(num_classes, VIT_B16, **kw)
+
+
+def vit_cifar(num_classes: int = 10, **kw) -> L.Layer:
+    """CIFAR-scale ViT (32² images, 4×4 patches)."""
+    return vit(num_classes, VIT_CIFAR, **kw)
