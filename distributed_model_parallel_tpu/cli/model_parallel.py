@@ -96,7 +96,11 @@ def build_stages(model: str, num_stages: int, num_classes: int,
             )
         boundaries = [3, 9, 15]
     if model not in STAGE_BUILDERS:
-        raise SystemExit(f"unknown model {model!r}")
+        raise SystemExit(
+            f"model {model!r} has no pipeline stage builder; "
+            f"pipeline-splittable models: {sorted(STAGE_BUILDERS)}. "
+            f"(Every model trains under the data-parallel CLI.)"
+        )
     return STAGE_BUILDERS[model](num_stages, num_classes, boundaries)
 
 
