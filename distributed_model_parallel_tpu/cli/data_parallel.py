@@ -70,10 +70,13 @@ def build_parser() -> argparse.ArgumentParser:
     parser.add_argument("-j", "--workers", default=1, type=int,
                         help="native augmentation thread-pool size")
     # -- TPU-native additions --------------------------------------------
-    parser.add_argument("--engine", default="gspmd", choices=("gspmd", "ddp"),
+    parser.add_argument("--engine", default="gspmd",
+                        choices=("gspmd", "ddp", "fsdp"),
                         help="gspmd: compiler-partitioned (nn.DataParallel "
                              "equivalent); ddp: explicit shard_map psum "
-                             "(DistributedDataParallel equivalent)")
+                             "(DistributedDataParallel equivalent); fsdp: "
+                             "params+optimizer sharded 1/N over 'data' "
+                             "(ZeRO-3 equivalent)")
     parser.add_argument("--max-restarts", default=0, type=int,
                         help="fail-fast elastic mode: restart from the "
                              "per-epoch checkpoint up to N times on "
@@ -119,6 +122,10 @@ def main(argv=None) -> dict:
         engine = DDPEngine(
             model, opt, mesh, sync_bn=args.sync_bn, compute_dtype=cdt
         )
+    elif args.engine == "fsdp":
+        from distributed_model_parallel_tpu.parallel.fsdp import FSDPEngine
+
+        engine = FSDPEngine(model, opt, mesh, compute_dtype=cdt)
     else:
         engine = DataParallelEngine(model, opt, mesh, compute_dtype=cdt)
     checkpoint_dir = "./checkpoint"  # single source of truth (cfg + probes)
@@ -170,9 +177,15 @@ def main(argv=None) -> dict:
                 trainer.state.model_state,
                 load_torch_checkpoint(args.finetune),
             )
+            # Re-place in the ENGINE'S state layout: _state_sh for the
+            # sharded engines (FSDP keeps params/moments 1/N — a
+            # replicated put here would materialize the full state on
+            # every device, the OOM FSDP exists to avoid); replicated
+            # for DP/DDP.
+            placement = getattr(engine, "_state_sh", engine._repl)
             trainer.state = jax.device_put(
                 trainer.state._replace(params=p, model_state=s),
-                engine._repl,
+                placement,
             )
             print(f"==> Transplanted torch weights from {args.finetune}")
         return trainer

@@ -40,6 +40,16 @@ def test_data_parallel_cli_ddp_syncbn(tmp_path, monkeypatch):
     assert len(result["history"]) == 1
 
 
+def test_data_parallel_cli_fsdp(tmp_path, monkeypatch):
+    monkeypatch.chdir(tmp_path)
+    result = data_parallel.main([
+        "--engine", "fsdp", "--model", "tinycnn", "--optimizer", "adamw",
+        "-type", "Synthetic", "-b", "64", "--val-batch-size", "128",
+        "--epochs", "1", "--steps-per-epoch", "2", "--lr", "1e-3",
+    ])
+    assert len(result["history"]) == 1
+
+
 def test_model_parallel_cli(tmp_path, monkeypatch):
     monkeypatch.chdir(tmp_path)
     result = model_parallel.main([
