@@ -74,9 +74,10 @@ def build_parser() -> argparse.ArgumentParser:
                    help="'seq' mesh axis size (context parallelism); "
                         "1 = plain data parallelism")
     p.add_argument("--attention", default="ring",
-                   choices=("ring", "ring_flash", "ulysses"),
-                   help="ring_flash = Pallas kernels per ring hop (the "
-                        "long-context hot path on TPU)")
+                   choices=("ring", "ring_flash", "ulysses",
+                            "ulysses_flash"),
+                   help="*_flash = Pallas kernels as the attention core "
+                        "(the long-context hot paths on TPU)")
     p.add_argument("--dtype", default="float32",
                    choices=("float32", "bfloat16"))
     p.add_argument("--remat", action="store_true")

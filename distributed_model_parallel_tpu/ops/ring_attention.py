@@ -163,6 +163,7 @@ def ulysses_attention(
     axis_name: str = "seq",
     scale: Optional[float] = None,
     causal: bool = False,
+    attention_impl=None,
 ) -> jax.Array:
     """All-to-all sequence parallelism (DeepSpeed-Ulysses layout swap).
 
@@ -170,6 +171,11 @@ def ulysses_attention(
     sequence axis, heads divisible by the axis size: re-shards to
     head-parallel, runs ordinary full-sequence attention locally, and
     re-shards back to sequence-parallel.
+
+    `attention_impl` is the local full-sequence core (default
+    `dot_product_attention`); pass `pallas_attention.flash_attention`
+    (the `'ulysses_flash'` registry entry) to keep the local O(T²)
+    probability tiles in VMEM — same motivation as ring_flash.
     """
     n = lax.psum(1, axis_name)
     h = q.shape[2]
@@ -194,7 +200,8 @@ def ulysses_attention(
         full_mask = lax.all_gather(mask, axis_name, axis=1, tiled=True)
     # After the all-to-all each device sees the FULL sequence for its
     # heads, so causality is the ordinary triangular mask locally.
-    out = dot_product_attention(
+    impl = attention_impl or dot_product_attention
+    out = impl(
         to_heads(q), to_heads(k), to_heads(v), full_mask, scale=scale,
         causal=causal,
     )

@@ -58,10 +58,19 @@ from distributed_model_parallel_tpu.parallel.data_parallel import (
 from distributed_model_parallel_tpu.training.metrics import cross_entropy
 from distributed_model_parallel_tpu.training.optim import SGD
 
+def _ulysses_flash(*args, **kw):
+    from distributed_model_parallel_tpu.ops.pallas_attention import (
+        flash_attention,
+    )
+
+    return ulysses_attention(*args, attention_impl=flash_attention, **kw)
+
+
 ATTENTION = {
     "ring": ring_attention,
     "ring_flash": ring_flash_attention,  # Pallas kernels per hop
     "ulysses": ulysses_attention,
+    "ulysses_flash": _ulysses_flash,     # Pallas kernel as the local core
 }
 
 

@@ -477,3 +477,39 @@ def test_lm_engine_ring_flash_trains():
         ts, m = eng.train_step(ts, i, t, jnp.float32(0.3))
         losses.append(float(m["loss_sum"]) / float(m["count"]))
     assert losses[-1] < losses[0]
+
+
+def test_ulysses_flash_matches_dense(sp_mesh):
+    """Ulysses with the Pallas kernel as its local core == dense
+    attention, forward and gradients (kernel-viable local length)."""
+    from distributed_model_parallel_tpu.parallel.sequence_parallel import (
+        ATTENTION,
+    )
+
+    b, t, h, dh = 1, 128, 4, 16
+    rng = np.random.RandomState(3)
+    mk = lambda: jnp.asarray(rng.randn(b, t, h, dh).astype(np.float32))
+    q, k, v = mk(), mk(), mk()
+    mask = jnp.asarray(rng.rand(b, t) > 0.2).at[:, 0].set(True)
+    spec = P(None, ("seq",))
+    f = jax.jit(shard_map(
+        partial(ATTENTION["ulysses_flash"], axis_name="seq", causal=True),
+        mesh=sp_mesh,
+        in_specs=(spec, spec, spec, P(None, ("seq",))),
+        out_specs=spec,
+        check_vma=False,
+    ))
+    want = dot_product_attention(q, k, v, mask, causal=True)
+    np.testing.assert_allclose(
+        np.asarray(f(q, k, v, mask)), np.asarray(want),
+        rtol=2e-5, atol=2e-5,
+    )
+    g = jax.grad(lambda v: jnp.sum(f(q, k, v, mask) ** 2))(v)
+    gw = jax.grad(
+        lambda v: jnp.sum(
+            dot_product_attention(q, k, v, mask, causal=True) ** 2
+        )
+    )(v)
+    np.testing.assert_allclose(
+        np.asarray(g), np.asarray(gw), rtol=2e-4, atol=2e-5
+    )
