@@ -418,6 +418,19 @@ class CausalLMSequenceParallelEngine:
         ('data', 'seq'). `labels` is ignored (the LM's targets are the
         shifted ids); the parameter keeps the engine signature-uniform
         with the classification engines."""
+        # The forward's per-shard position lookup uses dynamic_slice,
+        # which CLAMPS out-of-range starts — shards past the table end
+        # would silently reuse the last rows instead of failing like the
+        # dense stem's broadcast does. Validate the global length here,
+        # where the first real batch's T is known.
+        if ids.shape[1] > self.cfg.max_position:
+            raise ValueError(
+                f"global sequence length {ids.shape[1]} exceeds the "
+                f"position table (max_position={self.cfg.max_position}); "
+                f"later 'seq' shards would silently reuse position rows. "
+                f"Raise GPTConfig.max_position to at least the sequence "
+                f"length."
+            )
         targets = self._lm_targets(ids)
         ids_arr = _place_batch((ids,), self._batch)[0]
         targets_arr = _place_batch((targets,), self._batch)[0]
