@@ -74,6 +74,23 @@ ATTENTION = {
 }
 
 
+def _check_seq_len(ids, max_position: int, cfg_name: str) -> None:
+    """Refuse global sequence lengths past the position table.
+
+    Both SP engines' forwards slice the table with `dynamic_slice`, which
+    CLAMPS out-of-range starts — shards past the table end would silently
+    reuse the last position rows instead of failing like the dense stem's
+    broadcast does. Validate in shard_batch, where the first real batch's
+    T is known."""
+    if ids.shape[1] > max_position:
+        raise ValueError(
+            f"global sequence length {ids.shape[1]} exceeds the "
+            f"position table (max_position={max_position}); later 'seq' "
+            f"shards would silently reuse position rows. Raise "
+            f"{cfg_name}.max_position to at least the sequence length."
+        )
+
+
 @dataclasses.dataclass
 class SequenceParallelEngine:
     """BERT-family classification training with 'seq'-sharded activations.
@@ -238,6 +255,7 @@ class SequenceParallelEngine:
 
     def shard_batch(self, ids, labels):
         """ids shard over ('data', 'seq'); labels over 'data' only."""
+        _check_seq_len(ids, self.cfg.max_position, "BertConfig")
         ids_arr = _place_batch((ids,), self._batch)[0]
         labels_arr = _place_batch((labels,), self._labels)[0]
         return ids_arr, labels_arr
@@ -418,19 +436,7 @@ class CausalLMSequenceParallelEngine:
         ('data', 'seq'). `labels` is ignored (the LM's targets are the
         shifted ids); the parameter keeps the engine signature-uniform
         with the classification engines."""
-        # The forward's per-shard position lookup uses dynamic_slice,
-        # which CLAMPS out-of-range starts — shards past the table end
-        # would silently reuse the last rows instead of failing like the
-        # dense stem's broadcast does. Validate the global length here,
-        # where the first real batch's T is known.
-        if ids.shape[1] > self.cfg.max_position:
-            raise ValueError(
-                f"global sequence length {ids.shape[1]} exceeds the "
-                f"position table (max_position={self.cfg.max_position}); "
-                f"later 'seq' shards would silently reuse position rows. "
-                f"Raise GPTConfig.max_position to at least the sequence "
-                f"length."
-            )
+        _check_seq_len(ids, self.cfg.max_position, "GPTConfig")
         targets = self._lm_targets(ids)
         ids_arr = _place_batch((ids,), self._batch)[0]
         targets_arr = _place_batch((targets,), self._batch)[0]

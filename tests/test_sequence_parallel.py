@@ -265,6 +265,43 @@ def test_sequence_parallel_eval_and_checkpoint_interop(sp_mesh):
     assert np.isfinite(float(m["loss_sum"]))
 
 
+def test_shard_batch_rejects_overlong_sequences(sp_mesh):
+    """Both SP engines' forward passes slice the position table with
+    dynamic_slice, which CLAMPS out-of-range starts — so a T beyond
+    max_position would silently reuse the last position rows on later
+    'seq' shards. shard_batch must refuse instead; T == max_position is
+    the boundary and must pass."""
+    from distributed_model_parallel_tpu.models.bert import BertConfig
+    from distributed_model_parallel_tpu.models.gpt import GPTConfig
+    from distributed_model_parallel_tpu.parallel.sequence_parallel import (
+        CausalLMSequenceParallelEngine,
+        SequenceParallelEngine,
+    )
+    from distributed_model_parallel_tpu.training.optim import SGD
+
+    bert_cfg = BertConfig(
+        vocab_size=67, hidden_size=32, num_layers=1, num_heads=4,
+        intermediate_size=64, max_position=T, dropout_rate=0.0,
+    )
+    sp = SequenceParallelEngine(bert_cfg, 4, SGD(), sp_mesh, donate=False)
+    rng = np.random.RandomState(0)
+    labels = rng.randint(0, 4, size=(8,)).astype(np.int32)
+    ok = rng.randint(1, 67, size=(8, T)).astype(np.int32)
+    sp.shard_batch(ok, labels)  # boundary length passes
+    too_long = rng.randint(1, 67, size=(8, 2 * T)).astype(np.int32)
+    with pytest.raises(ValueError, match="max_position"):
+        sp.shard_batch(too_long, labels)
+
+    gpt_cfg = GPTConfig(
+        vocab_size=61, dim=32, num_layers=1, num_heads=4, ffn_dim=64,
+        max_position=T, dropout_rate=0.0,
+    )
+    lm = CausalLMSequenceParallelEngine(gpt_cfg, SGD(), sp_mesh, donate=False)
+    lm.shard_batch(rng.randint(1, 61, size=(8, T)).astype(np.int32))
+    with pytest.raises(ValueError, match="max_position"):
+        lm.shard_batch(rng.randint(1, 61, size=(8, 2 * T)).astype(np.int32))
+
+
 # ---------------------------------------------------------------------------
 # Causal attention (decoder-style) across all attention implementations.
 # ---------------------------------------------------------------------------
