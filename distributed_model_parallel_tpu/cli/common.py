@@ -28,6 +28,37 @@ from distributed_model_parallel_tpu.models import (
     vit_cifar,
 )
 
+def _bert_tiny_cfg():
+    from distributed_model_parallel_tpu.models.bert import BertConfig
+
+    # Sized for the SyntheticText task (vocab 512, seq 64) and fast
+    # CI/smoke compiles; the full 'bert' entry uses BERT_BASE.
+    return BertConfig(
+        vocab_size=512, hidden_size=128, num_layers=4, num_heads=4,
+        intermediate_size=256, max_position=128,
+    )
+
+
+def _bert_model(num_classes: int, cfg=None, *, remat: bool = False):
+    from distributed_model_parallel_tpu.models.bert import (
+        BERT_BASE,
+        bert_for_classification,
+    )
+
+    return bert_for_classification(
+        num_classes, cfg or BERT_BASE, remat=remat
+    )
+
+
+def _bert_stages(num_stages, num_classes, boundaries, cfg=None):
+    from distributed_model_parallel_tpu.models import bert
+
+    return bert.split_stages(
+        num_stages, num_classes, cfg or bert.BERT_BASE,
+        boundaries=boundaries,
+    )
+
+
 MODELS = {
     "mobilenetv2": mobilenet_v2,
     "mobilenetv2_nobn": mobilenet_v2_nobn,
@@ -35,6 +66,11 @@ MODELS = {
     "resnet50": resnet50,
     "tinycnn": tiny_cnn,
     "vit": vit_cifar,  # CIFAR-scale ViT (32^2 inputs, 4x4 patches)
+    # Token-id classifiers (pair with --dataset-type SyntheticText):
+    "bert": _bert_model,
+    "bert_tiny": lambda c, *, remat=False: _bert_model(
+        c, _bert_tiny_cfg(), remat=remat
+    ),
 }
 
 # Pipeline stage builders, kept beside MODELS so both CLIs extend in one
@@ -53,6 +89,9 @@ STAGE_BUILDERS = {
         50, n, c, boundaries=b
     ),
     "tinycnn": lambda n, c, b: tinycnn.split_stages(n, c, boundaries=b),
+    # Transformer pipelines: the wire carries the (hidden, mask) pair.
+    "bert": _bert_stages,
+    "bert_tiny": lambda n, c, b: _bert_stages(n, c, b, _bert_tiny_cfg()),
 }
 
 
@@ -87,6 +126,9 @@ def build_loaders(
     augment: bool = True,
     seed: int = 0,
     workers: int = 1,
+    device_normalize: bool = False,
+    compose_train=None,
+    compose_val=None,
 ):
     """(train_loader, val_loader, num_classes) with per-host sharding —
     the DistributedSampler the reference lacks (`utils.py:21`).
@@ -94,19 +136,23 @@ def build_loaders(
     `batch_size` / `val_batch_size` are GLOBAL batch sizes (the reference's
     `-b 512` means 512 total, and lr=0.4 is tuned to that); each host's
     Loader draws global/process_count samples per step."""
-    procs = jax.process_count()
-    if batch_size % procs:
+    procs = _check_process_divisibility(batch_size, val_batch_size)
+    if device_normalize and (compose_train or compose_val):
         raise SystemExit(
-            f"global batch size {batch_size} must be divisible by the "
-            f"process count {procs}"
+            "--device-normalize conflicts with caller-supplied compose "
+            "transforms: the compose replaces the host normalize, and "
+            "the engine would normalize its output AGAIN on device"
         )
-    if val_batch_size is not None and val_batch_size % procs:
-        raise SystemExit(
-            f"global val batch size {val_batch_size} must be divisible by "
-            f"the process count {procs}"
-        )
-    train_ds, val_ds = DatasetCollection(dataset_type, data_path).init()
+    collection = DatasetCollection(
+        dataset_type, data_path, compose_train, compose_val
+    )
+    train_ds, val_ds = collection.init()
     mean, std = stats_for(dataset_type)
+    text = getattr(train_ds, "kind", "image") == "text"
+    if text:
+        # Token-id batches: no crop/flip, no /255-mean/std — raw wire.
+        mean = std = None
+        augment = False
     train = Loader(
         train_ds,
         batch_size=batch_size // procs,
@@ -118,6 +164,11 @@ def build_loaders(
         process_index=jax.process_index(),
         process_count=jax.process_count(),
         workers=workers,
+        device_normalize=device_normalize,
+        raw=text,
+        # The collection is the single source of truth for the composes
+        # (the reference's constructor surface); read them back from it.
+        transform=collection.compose_train,
     )
     val = Loader(
         val_ds,
@@ -130,8 +181,67 @@ def build_loaders(
         process_count=jax.process_count(),
         drop_last=False,
         workers=workers,
+        device_normalize=device_normalize,
+        raw=text,
+        transform=collection.compose_val,
     )
     return train, val, train_ds.num_classes
+
+
+def _check_process_divisibility(
+    batch_size: int, val_batch_size: int | None
+) -> int:
+    """Shared by `build_loaders` / `build_index_loaders`: global batches
+    must divide across hosts. Returns the process count."""
+    procs = jax.process_count()
+    if batch_size % procs:
+        raise SystemExit(
+            f"global batch size {batch_size} must be divisible by the "
+            f"process count {procs}"
+        )
+    if val_batch_size is not None and val_batch_size % procs:
+        raise SystemExit(
+            f"global val batch size {val_batch_size} must be divisible by "
+            f"the process count {procs}"
+        )
+    return procs
+
+
+def build_index_loaders(
+    dataset_type: str,
+    data_path: str,
+    batch_size: int,
+    mesh,
+    *,
+    val_batch_size: int | None = None,
+    augment: bool = True,
+    seed: int = 0,
+):
+    """The `--device-cache` twin of `build_loaders`: same per-host batch
+    division and dataset construction, but the loaders yield INDEX
+    vectors and the whole dataset uploads to HBM once (`combined_cache`).
+    Returns (train_loader, val_loader, num_classes, input_transform)."""
+    from distributed_model_parallel_tpu.data.device_cache import (
+        IndexLoader,
+        combined_cache,
+    )
+
+    procs = _check_process_divisibility(batch_size, val_batch_size)
+    train_ds, val_ds = DatasetCollection(dataset_type, data_path).init()
+    mean, std = stats_for(dataset_type)
+    transform, val_off = combined_cache(
+        train_ds, val_ds, mesh, augment=augment, mean=mean, std=std,
+    )
+    train = IndexLoader(
+        train_ds, batch_size=batch_size // procs, shuffle=True, seed=seed,
+        process_index=jax.process_index(), process_count=procs,
+    )
+    val = IndexLoader(
+        val_ds, batch_size=(val_batch_size or batch_size) // procs,
+        shuffle=False, drop_last=False, index_offset=val_off,
+        process_index=jax.process_index(), process_count=procs,
+    )
+    return train, val, train_ds.num_classes, transform
 
 
 def check_batch_divisibility(
@@ -191,6 +301,13 @@ def add_common_tpu_flags(parser: argparse.ArgumentParser) -> None:
         "--steps-per-epoch", default=0, type=int,
         help="truncate each epoch to N batches (0 = full epoch); "
              "for smoke runs and benchmarking",
+    )
+    parser.add_argument(
+        "--steps-per-dispatch", default=1, type=int,
+        help="fold N optimizer steps into one compiled dispatch "
+             "(lax.scan; trajectory-identical to per-step). Amortizes "
+             "host->device round-trips — the dominant end-to-end cost "
+             "on a relay-attached accelerator (RESULTS 1c)",
     )
     parser.add_argument(
         "--log-file", default=None,

@@ -83,6 +83,16 @@ def build_parser() -> argparse.ArgumentParser:
                              "failure (0 = off)")
     parser.add_argument("--sync-bn", action="store_true",
                         help="SyncBatchNorm semantics under --engine ddp")
+    parser.add_argument("--device-normalize", action="store_true",
+                        help="ship uint8 batches and normalize on device "
+                             "(4x fewer host->device bytes; same math)")
+    parser.add_argument("--device-cache", action="store_true",
+                        help="upload the whole dataset to HBM once and "
+                             "ship only per-batch INDEX vectors (~2 KB); "
+                             "gather+augment+normalize run inside the "
+                             "compiled step. For HBM-sized datasets "
+                             "(CIFAR); the end-to-end fast path on a "
+                             "bandwidth-limited host link")
     add_common_tpu_flags(parser)
     return parser
 
@@ -110,24 +120,61 @@ def main(argv=None) -> dict:
     mesh = make_mesh(MeshSpec(data=-1))
     check_batch_divisibility(args.batch_size, mesh)
     check_batch_divisibility(args.val_batch_size, mesh, label="val batch")
-    train, val, num_classes = build_loaders(
-        args.dataset_type, args.data, args.batch_size,
-        val_batch_size=args.val_batch_size,
-        workers=args.workers,
-    )
+    if args.dataset_type == "SyntheticText" and (
+        args.device_cache or args.device_normalize
+    ):
+        raise SystemExit(
+            "--device-cache/--device-normalize apply the image "
+            "normalize pipeline; token-id datasets ship raw (and are "
+            "small on the wire already)"
+        )
+    itf = None
+    if args.device_cache:
+        if args.device_normalize:
+            raise SystemExit(
+                "--device-cache already normalizes on device; "
+                "drop --device-normalize"
+            )
+        from distributed_model_parallel_tpu.cli.common import (
+            build_index_loaders,
+        )
+
+        train, val, num_classes, itf = build_index_loaders(
+            args.dataset_type, args.data, args.batch_size, mesh,
+            val_batch_size=args.val_batch_size,
+        )
+    else:
+        train, val, num_classes = build_loaders(
+            args.dataset_type, args.data, args.batch_size,
+            val_batch_size=args.val_batch_size,
+            workers=args.workers,
+            device_normalize=args.device_normalize,
+        )
     model = build_model(args.model, num_classes, remat=args.remat)
     opt = build_optimizer(args)
     cdt = compute_dtype_from_flag(args.dtype)
+    if args.device_normalize:
+        from distributed_model_parallel_tpu.cli.common import stats_for
+        from distributed_model_parallel_tpu.data.loader import (
+            device_normalizer,
+        )
+
+        itf = device_normalizer(*stats_for(args.dataset_type))
     if args.engine == "ddp":
         engine = DDPEngine(
-            model, opt, mesh, sync_bn=args.sync_bn, compute_dtype=cdt
+            model, opt, mesh, sync_bn=args.sync_bn, compute_dtype=cdt,
+            input_transform=itf,
         )
     elif args.engine == "fsdp":
         from distributed_model_parallel_tpu.parallel.fsdp import FSDPEngine
 
-        engine = FSDPEngine(model, opt, mesh, compute_dtype=cdt)
+        engine = FSDPEngine(
+            model, opt, mesh, compute_dtype=cdt, input_transform=itf
+        )
     else:
-        engine = DataParallelEngine(model, opt, mesh, compute_dtype=cdt)
+        engine = DataParallelEngine(
+            model, opt, mesh, compute_dtype=cdt, input_transform=itf
+        )
     checkpoint_dir = "./checkpoint"  # single source of truth (cfg + probes)
 
     def _restart_can_resume() -> bool:
@@ -162,6 +209,7 @@ def main(argv=None) -> dict:
             checkpoint_dir=checkpoint_dir,
             resume=resume,
             steps_per_epoch=args.steps_per_epoch,
+            steps_per_dispatch=args.steps_per_dispatch,
             profile_dir=args.profile_dir,
             save_last=args.max_restarts > 0,
         )

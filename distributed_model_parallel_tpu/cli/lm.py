@@ -82,6 +82,9 @@ def build_parser() -> argparse.ArgumentParser:
                    choices=("float32", "bfloat16"))
     p.add_argument("--remat", action="store_true")
     p.add_argument("--steps-per-epoch", default=0, type=int)
+    p.add_argument("--steps-per-dispatch", default=1, type=int,
+                   help="fold N optimizer steps into one compiled "
+                        "dispatch (lax.scan; trajectory-identical)")
     p.add_argument("--log-file", default=None)
     p.add_argument("--profile-dir", default=None)
     p.add_argument("--resume", "-r", action="store_true")
@@ -138,6 +141,7 @@ def main(argv=None) -> dict:
         log_file=args.log_file or f"lm_{args.batch_size}.txt",
         resume=args.resume,
         steps_per_epoch=args.steps_per_epoch,
+        steps_per_dispatch=args.steps_per_dispatch,
         profile_dir=args.profile_dir,
     )
     trainer = Trainer(engine, train, val, tcfg, rng=jax.random.PRNGKey(0))

@@ -134,6 +134,7 @@ def main(argv=None) -> dict:
         warmup_period=10,
         log_file=args.log_file or f"{args.batch_size}.txt",
         steps_per_epoch=args.steps_per_epoch,
+        steps_per_dispatch=args.steps_per_dispatch,
         profile_dir=args.profile_dir,
     )
     trainer = Trainer(engine, train, val, cfg, rng=jax.random.PRNGKey(0))

@@ -33,11 +33,14 @@ IMAGENET_STD = np.array([0.229, 0.224, 0.225], np.float32)
 
 @dataclasses.dataclass
 class ArrayDataset:
-    """In-memory dataset: images NHWC uint8, labels int64."""
+    """In-memory dataset: images NHWC uint8 (or, for `kind='text'`,
+    int32 token ids (N, T) still under the `images` field — the Loader
+    treats text batches as raw pass-through), labels int64."""
 
     images: np.ndarray
     labels: np.ndarray
     num_classes: int
+    kind: str = "image"  # 'image' | 'text' — drives Loader defaults
 
     def __len__(self) -> int:
         return len(self.labels)
@@ -98,6 +101,47 @@ def synthetic(
     noise = rng.randint(-40, 40, size=(num_examples, image_size, image_size, 3))
     images = np.clip(class_means[labels] + noise, 0, 255).astype(np.uint8)
     return ArrayDataset(images, labels.astype(np.int64), num_classes)
+
+
+def synthetic_text(
+    num_examples: int = 2048,
+    seq_len: int = 64,
+    num_classes: int = 4,
+    vocab_size: int = 512,
+    seed: int = 0,
+) -> ArrayDataset:
+    """Deterministic text-CLASSIFICATION dataset: each class is its own
+    first-order Markov chain over tokens [1, vocab) (0 stays reserved
+    for padding — BERT's attention mask is `ids != 0`), so a model can
+    classify by transition statistics — a real, learnable signal for the
+    transformer-family engines (the text twin of `synthetic`'s
+    class-mean images).
+
+    Like `synthetic`, the per-class chains come from a FIXED rng
+    independent of `seed`, so train/val splits with different seeds
+    share one task and val accuracy measures generalization."""
+    v = vocab_size - 1  # usable tokens 1..vocab-1
+    class_rng = np.random.RandomState(4321)
+    # Per-class transition logits with strong structure (peaked rows).
+    trans = class_rng.dirichlet(
+        np.full(v, 0.05), size=(num_classes, v)
+    )  # (C, v, v) rows sum to 1
+    rng = np.random.RandomState(seed)
+    labels = rng.randint(0, num_classes, size=(num_examples,))
+    ids = np.empty((num_examples, seq_len), np.int32)
+    ids[:, 0] = rng.randint(0, v, size=num_examples)
+    # Vectorized walk: one step for ALL sequences at a time via inverse-
+    # CDF sampling against each row's class-specific transition row.
+    cdf = np.cumsum(trans, axis=-1)  # (C, v, v)
+    for t in range(1, seq_len):
+        u = rng.rand(num_examples, 1)
+        row_cdf = cdf[labels, ids[:, t - 1]]  # (N, v)
+        # Clip: a float cumsum row can top out at 1-eps rather than 1.0,
+        # and a u above it would index one past the table.
+        ids[:, t] = np.minimum((u > row_cdf).sum(axis=1), v - 1)
+    return ArrayDataset(
+        ids + 1, labels.astype(np.int64), num_classes, kind="text"
+    )
 
 
 def _load_cifar10_batches(root: str) -> Optional[Tuple[np.ndarray, ...]]:
@@ -217,14 +261,25 @@ def cub200(root: str, image_size: int = 224):
 
 class DatasetCollection:
     """String-keyed factory with the reference's exact API shape:
-    `DatasetCollection(type, path, ...).init() -> (train, val)`
-    (`dataset_collection.py:28-35`). Types: 'CIFAR10', 'Imagenet', 'CUB200',
-    'Place365', plus 'Synthetic'."""
+    `DatasetCollection(type, path, compose_train, compose_val).init() ->
+    (train, val)` (`dataset_collection.py:28-35`). Types: 'CIFAR10',
+    'Imagenet', 'CUB200', 'Place365', plus 'Synthetic' and
+    'SyntheticText' (token-id classification for the transformer
+    family).
+
+    `compose_train` / `compose_val` mirror the reference's
+    caller-supplied torchvision Compose arguments: per-batch callables
+    `(images, labels) -> (images, labels)` applied by the Loader INSTEAD
+    of its built-in augment/normalize path (`Loader.transform`). Leave
+    them None for the reference's default CIFAR transforms."""
 
     def __init__(self, dataset_type: str, dataset_path: str = "./data",
+                 compose_train=None, compose_val=None,
                  image_size: int = 224):
         self.dataset_type = dataset_type
         self.dataset_path = dataset_path
+        self.compose_train = compose_train
+        self.compose_val = compose_val
         self.image_size = image_size
 
     def init(self):
@@ -233,6 +288,11 @@ class DatasetCollection:
             return cifar10(self.dataset_path)
         if t == "Synthetic":
             return synthetic(2048, 32, 10, seed=1), synthetic(512, 32, 10, seed=2)
+        if t == "SyntheticText":
+            return (
+                synthetic_text(4096, 64, 4, seed=1),
+                synthetic_text(1024, 64, 4, seed=2),
+            )
         if t in ("Imagenet", "Place365"):
             return image_folder(self.dataset_path, image_size=self.image_size)
         if t == "CUB200":

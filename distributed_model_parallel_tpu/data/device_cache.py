@@ -1,0 +1,226 @@
+"""Device-resident dataset cache — the input pipeline for datasets that
+fit in HBM.
+
+The reference's DataLoader re-ships every batch host->GPU each step
+(`utils.py:42-72`); on a co-located host that link is PCIe and free-ish.
+On TPU the idiomatic move for CIFAR-sized data is to stop shipping pixels
+at all: upload the whole uint8 dataset ONCE (CIFAR-10 train = 153 MB —
+noise against a 16 GB HBM), then each step sends only the batch's INDEX
+vector (~2 KB) and the compiled train step does the gather, the
+crop/flip augmentation, and the normalize on device. Measured on this
+host's relay-attached chip, that turns an input path that was
+bandwidth-bound at ~97 ms/batch (uint8) into a dispatch-bound one at
+the compiled step rate (RESULTS §1c).
+
+Composition contract:
+* `IndexLoader` (below) reproduces `Loader`'s sampling EXACTLY — same
+  per-epoch seeded permutation, same per-host strided shard, same
+  batching — but yields `(indices, labels)` instead of pixels.
+* `DeviceDatasetCache.transform()` is an `Engine.input_transform` with
+  `wants_ctx = True`: engines call it as `tf(indices, step=..,
+  train=..)` inside the jitted step. The cache arrays are closed over
+  as replicated device constants.
+* Augmentation draws are keyed by (augment_seed, step) with
+  `jax.random` ON DEVICE — the same crop/flip distribution as the host
+  path but a different (equally valid) random stream; trajectories
+  match the host loader's in distribution, not bit-for-bit.
+
+Datasets that do NOT fit in HBM (ImageNet at full res) keep the host
+`Loader` path; this cache refuses datasets above `max_bytes` loudly.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+import numpy as np
+
+from distributed_model_parallel_tpu.data.datasets import ArrayDataset
+from distributed_model_parallel_tpu.data.loader import Loader
+
+
+class DeviceDatasetCache:
+    """Upload `dataset` once (uint8 NHWC images replicated over the
+    mesh) and build the device-side gather+augment+normalize transform.
+    """
+
+    def __init__(
+        self,
+        dataset: ArrayDataset,
+        mesh,
+        *,
+        augment: bool = False,
+        mean: Optional[np.ndarray] = None,
+        std: Optional[np.ndarray] = None,
+        padding: int = 4,
+        augment_seed: int = 0,
+        max_bytes: int = 2 << 30,
+    ):
+        import jax
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        images = (
+            dataset if isinstance(dataset, np.ndarray) else dataset.images
+        )
+        if images.nbytes > max_bytes:
+            raise ValueError(
+                f"dataset is {images.nbytes / 1e9:.1f} GB uint8 — beyond "
+                f"the device-cache budget ({max_bytes / 1e9:.1f} GB "
+                f"replicated per device). Use the host Loader path."
+            )
+        repl = NamedSharding(mesh, P())
+        if jax.process_count() == 1:
+            self.images = jax.device_put(images, repl)
+        else:
+            # Every host loads the identical full dataset (the Loader
+            # shards INDICES, not storage), so the replicated global
+            # array assembles from identical per-process data.
+            self.images = jax.make_array_from_process_local_data(
+                repl, images
+            )
+        self.augment = augment
+        self.padding = padding
+        self.augment_seed = augment_seed
+        self.mean = None if mean is None else np.asarray(mean, np.float32)
+        self.std = None if std is None else np.asarray(std, np.float32)
+
+    def transform(self):
+        """The `Engine.input_transform`: indices -> normalized f32 batch,
+        entirely on device. `wants_ctx = True` makes engines pass
+        (step, train); augmentation applies only when train=True."""
+        import jax
+        import jax.numpy as jnp
+
+        cache = self.images
+        p = self.padding
+        mean, std = self.mean, self.std
+        augment = self.augment
+        seed = self.augment_seed
+
+        def tf(indices, *, step=None, train=False):
+            imgs = jnp.take(cache, indices, axis=0)
+            if augment and train:
+                b = imgs.shape[0]
+                h, w = imgs.shape[1], imgs.shape[2]
+                # Fold the first index into the key: under a shard_map
+                # engine (DDP) the transform runs once PER SHARD with the
+                # same `step`, and a step-only key would hand every shard
+                # identical (ys, xs, flips) vectors. The shards' index
+                # slices are disjoint, so indices[0] distinguishes them
+                # — and under plain GSPMD jit there is one global call,
+                # where any fold is fine.
+                key = jax.random.fold_in(
+                    jax.random.fold_in(jax.random.PRNGKey(seed), step),
+                    indices[0],
+                )
+                ky, kx, kf = jax.random.split(key, 3)
+                ys = jax.random.randint(ky, (b,), 0, 2 * p + 1)
+                xs = jax.random.randint(kx, (b,), 0, 2 * p + 1)
+                flips = jax.random.bernoulli(kf, 0.5, (b,))
+                padded = jnp.pad(
+                    imgs, ((0, 0), (p, p), (p, p), (0, 0))
+                )
+                # Per-image crop as TWO single-axis gathers
+                # (take_along_axis rows, then cols) — measured 7x faster
+                # on a v5e than the vmap(dynamic_slice) formulation
+                # (1.3 ms vs 9.4 ms at B=512), bit-identical, uint8
+                # throughout.
+                rows = ys[:, None] + jnp.arange(h)[None, :]
+                cols = xs[:, None] + jnp.arange(w)[None, :]
+                imgs = jnp.take_along_axis(
+                    padded, rows[:, :, None, None], axis=1
+                )
+                imgs = jnp.take_along_axis(
+                    imgs, cols[:, None, :, None], axis=2
+                )
+                imgs = jnp.where(
+                    flips[:, None, None, None], imgs[:, :, ::-1, :], imgs
+                )
+            out = imgs.astype(jnp.float32) / 255.0
+            if mean is not None:
+                out = (out - jnp.asarray(mean)) / jnp.asarray(std)
+            return out
+
+        tf.wants_ctx = True
+        return tf
+
+
+def combined_cache(
+    train_ds: ArrayDataset,
+    val_ds: ArrayDataset,
+    mesh,
+    *,
+    mean: Optional[np.ndarray] = None,
+    std: Optional[np.ndarray] = None,
+    augment: bool = True,
+    padding: int = 4,
+    augment_seed: int = 0,
+):
+    """One replicated cache holding train AND val images (engines have a
+    single `input_transform` serving both steps; augmentation applies
+    only under train=True). Returns `(transform, val_offset)` — build
+    the val `IndexLoader` with `index_offset=val_offset` so its indices
+    address the val block of the combined cache."""
+    images = np.concatenate([train_ds.images, val_ds.images])
+    cache = DeviceDatasetCache(
+        images, mesh, augment=augment, mean=mean, std=std,
+        padding=padding, augment_seed=augment_seed,
+    )
+    return cache.transform(), len(train_ds.images)
+
+
+@dataclasses.dataclass
+class IndexLoader(Loader):
+    """`Loader` with the pixel work removed: yields
+    `(int32 indices, labels)` per batch, identical sampling (per-epoch
+    seeded permutation, per-host strided shard, static batch shapes).
+    Ragged final batches pad indices with row 0 and labels with -1
+    (metrics mask the padding rows; the gathered pixels are dead).
+
+    The index vector is the ONLY per-step host->device traffic, which
+    is the point: ~2 KB/step against 1.5-6.3 MB for pixel batches.
+
+    `index_offset` shifts every yielded index — the val loader of a
+    `combined_cache` addresses the val block of the shared array."""
+
+    index_offset: int = 0
+
+    def __post_init__(self):
+        super().__post_init__()
+        # The pixel-path Loader fields are DEAD here (augment/normalize
+        # live in DeviceDatasetCache.transform, on device); accepting
+        # them silently would let a caller believe host augmentation is
+        # happening when it is not.
+        if (
+            self.augment or self.transform is not None
+            or self.device_normalize or self.mean is not None
+        ):
+            raise ValueError(
+                "IndexLoader yields indices, not pixels: augment/"
+                "mean/std/transform/device_normalize have no effect "
+                "here — configure augmentation and normalization on "
+                "DeviceDatasetCache/combined_cache instead"
+            )
+
+    def _make_batch(self, b: int, idx, use_native: bool):
+        ds = self.dataset
+        if hasattr(ds, "labels"):
+            labels = ds.labels[idx]  # skip the host-side pixel gather
+        else:
+            _, labels = self._gather(idx)
+        indices = np.asarray(idx, np.int32) + self.index_offset
+        if len(idx) < self.batch_size:
+            # Pad indices with a valid row (its gathered pixels are dead
+            # — label -1 masks the row out of loss and metrics).
+            pad_n = self.batch_size - len(idx)
+            indices = np.concatenate(
+                [indices, np.zeros((pad_n,), np.int32)]
+            )
+            labels = np.concatenate(
+                [labels, np.full((pad_n,), -1, labels.dtype)]
+            )
+        return indices, labels
+
+
+__all__ = ["DeviceDatasetCache", "IndexLoader", "combined_cache"]

@@ -72,6 +72,26 @@ def normalize(images: np.ndarray, mean: np.ndarray, std: np.ndarray) -> np.ndarr
     return (images.astype(np.float32) / 255.0 - mean) / std
 
 
+def device_normalizer(mean: np.ndarray, std: np.ndarray):
+    """The same `/255 - mean / std` normalize as a jit-traceable device
+    transform, for `Engine.input_transform`. Pair with
+    `Loader(device_normalize=True)`: the batch crosses the host->device
+    link as uint8 (4x fewer bytes than host-normalized f32 — the link is
+    the end-to-end bottleneck on a relay-attached accelerator, RESULTS
+    §1c) and XLA fuses the normalize into the first conv's input."""
+    mean = np.asarray(mean, np.float32)
+    std = np.asarray(std, np.float32)
+
+    def transform(images):
+        import jax.numpy as jnp  # keep this module importable without jax
+
+        m = jnp.asarray(mean)
+        s = jnp.asarray(std)
+        return (images.astype(jnp.float32) / 255.0 - m) / s
+
+    return transform
+
+
 @dataclasses.dataclass
 class Loader:
     """Deterministic, host-sharded batch iterator.
@@ -111,10 +131,30 @@ class Loader:
     workers: int = 1
     prefetch: int = 2
     use_native: Optional[bool] = None  # None = auto-detect
+    # Yield AUGMENTED UINT8 batches (no host normalize, no float cast):
+    # the engine normalizes on device via `input_transform =
+    # device_normalizer(mean, std)`. Cuts host->device bytes 4x.
+    device_normalize: bool = False
+    # Yield gathered batches untouched (no augment, no normalize, no
+    # dtype cast) — for non-image data (token ids) where /255 would be
+    # nonsense. Ragged-final-batch padding still applies.
+    raw: bool = False
+    # Caller-supplied per-batch transform `(arrays, labels) -> (arrays,
+    # labels)` REPLACING the built-in augment/normalize — the
+    # reference's compose_train/compose_val surface
+    # (`dataset_collection.py:28-35`). Runs on host, before padding.
+    transform: Optional[callable] = None
 
     def __post_init__(self):
         if self.batch_size < 1:
             raise ValueError(f"batch_size must be >= 1, got {self.batch_size}")
+        if self.device_normalize and self.use_native is True:
+            raise ValueError(
+                "device_normalize=True conflicts with use_native=True: "
+                "the native hot loop is the fused host-side "
+                "augment+NORMALIZE; with device-side normalization the "
+                "augmentation runs the vectorized NumPy uint8 path"
+            )
         if self.use_native is True and self.mean is None:
             raise ValueError(
                 "use_native=True requires mean/std (the native hot loop "
@@ -163,7 +203,19 @@ class Loader:
             ((self.seed + self._epoch) * 1009 + self.process_index) * 7919
             + b
         )
-        if self.augment:
+        if self.transform is not None:
+            images, labels = self.transform(images, labels)
+        elif self.raw:
+            pass  # token ids etc.: ship exactly what the dataset holds
+        elif self.device_normalize:
+            # Engine-side normalize: ship the (augmented) uint8 bytes.
+            # The augmentation draws use the SAME keyed RNG stream, so a
+            # device_normalize run sees identical crops/flips to a
+            # host-normalize run of the same (seed, epoch, host, batch).
+            if self.augment:
+                ys, xs, flips = _draw_augment(aug_rng, len(images), 4)
+                images = _crop_flip_numpy(images, ys, xs, flips, 4)
+        elif self.augment:
             ys, xs, flips = _draw_augment(aug_rng, len(images), 4)
             if (use_native and self.mean is not None
                     and images.dtype == np.uint8):

@@ -153,6 +153,47 @@ def gpt_lm(
     ])
 
 
+def _lm_head_flat(cfg: GPTConfig) -> L.Layer:
+    """The LM head for PIPELINE stages: same params as `_lm_head` (an
+    untied `w` — checkpoints interoperate), but logits flattened
+    (B, T, V) -> (B*T, V) to satisfy `PipelineEngine`'s (rows, classes)
+    last-stage contract. Feed targets pre-flattened the same way:
+    `lm_targets(ids).reshape(-1)` (row order matches — batch-major,
+    token-minor on both sides)."""
+    inner = _lm_head(cfg)
+
+    def apply(params, state, x, ctx):
+        logits, state = inner.apply(params, state, x, ctx)
+        b, t, v = logits.shape
+        return logits.reshape(b * t, v), state
+
+    return L.Layer(inner.init, apply)
+
+
+def split_stages(
+    num_stages: int,
+    cfg: GPTConfig,
+    *,
+    boundaries=None,
+    attention_fn: Optional[AttentionFn] = None,
+) -> List[L.Layer]:
+    """Pipeline stages for the decoder LM: stem (token+position
+    embeddings) on stage 0, decoder blocks distributed, flattening LM
+    head on the last stage — the same staging convention as
+    `models/bert.py::split_stages` (the wire carries the (hidden, mask)
+    pair between stages). Drive with `PipelineEngine` and labels
+    `lm_targets(ids).reshape(-1)`; the engine normalizes its loss by the
+    VALID (label != -1) row count, so gradients match the dense
+    per-token mean-loss convention of `lm_loss`."""
+    from distributed_model_parallel_tpu.models import staging
+
+    blocks = decoder_blocks(cfg, attention_fn)
+    cuts = staging.split_points(num_stages, boundaries, len(blocks))
+    return staging.assemble_stages(
+        blocks, _lm_stem(cfg), _lm_head_flat(cfg), cuts
+    )
+
+
 def lm_loss_fn(cfg: GPTConfig):
     """`lm_loss` bound to the config's pad_token_id — use this instead
     of raw `lm_loss` so loss masking can't silently fall out of sync

@@ -85,6 +85,19 @@ def _cast_input(x, dtype):
     return x.astype(dtype)
 
 
+def _apply_input_transform(tf, x, step, train):
+    """Run the engine's `input_transform` inside the compiled step.
+    Transforms with `wants_ctx = True` (the device-cache pipeline,
+    which needs per-step RNG and the train/eval distinction) receive
+    (step, train); plain transforms (device_normalizer) get the batch
+    alone."""
+    if tf is None:
+        return x
+    if getattr(tf, "wants_ctx", False):
+        return tf(x, step=step, train=train)
+    return tf(x)
+
+
 def aux_loss(state):
     """Sum of differentiable penalties layers stash in their post-forward
     state under the reserved key `"moe_aux"` (`models/moe.py`'s
@@ -128,6 +141,13 @@ class DataParallelEngine:
     # — the TPU MXU's native matmul dtype), params/optimizer/loss in f32.
     # None keeps the input dtype (f32 path).
     compute_dtype: Any = None
+    # Applied to the input batch INSIDE the compiled step, before the
+    # compute-dtype cast. Pair with `Loader(device_normalize=True)` /
+    # `device_normalizer(mean, std)` so image batches cross the
+    # host->device link as uint8 (4x fewer bytes than host-normalized
+    # f32 — the link is the end-to-end bottleneck on a relay-attached
+    # accelerator, RESULTS §1c) and are normalized on device.
+    input_transform: Any = None
     # NOTE: rematerialization lives at MODEL construction (per-block
     # `remat=True` on the model builders / `layers.remat`): a whole-model
     # checkpoint would re-live every residual at the start of backprop
@@ -138,13 +158,16 @@ class DataParallelEngine:
         self._repl = NamedSharding(mesh, P())
         self._batch = NamedSharding(mesh, P(("data",)))
         cdt = self.compute_dtype
+        tf = self.input_transform
         model = self.model
 
         def train_step(ts: TrainState, images, labels, lr):
             # Deterministic per-step dropout key (global batch => one key;
             # the partitioner shards the mask with the activations).
             rng = jax.random.fold_in(jax.random.PRNGKey(0), ts.step)
-            images_c = _cast_input(images, cdt)
+            images_c = _cast_input(
+                _apply_input_transform(tf, images, ts.step, True), cdt
+            )
 
             def loss_fn(params, model_state):
                 logits, new_state = model.apply(
@@ -166,8 +189,11 @@ class DataParallelEngine:
             return new_ts, _metrics(ce, logits, labels)
 
         def eval_step(ts: TrainState, images, labels):
+            images_c = _cast_input(
+                _apply_input_transform(tf, images, ts.step, False), cdt
+            )
             logits, _ = self.model.apply(  # eval: no backward, no remat
-                ts.params, ts.model_state, _cast_input(images, cdt),
+                ts.params, ts.model_state, images_c,
                 Context(train=False, dtype=cdt),
             )
             loss = cross_entropy(logits, labels)
@@ -222,6 +248,7 @@ class DDPEngine:
     sync_bn: bool = False
     donate: bool = True
     compute_dtype: Any = None  # see DataParallelEngine
+    input_transform: Any = None  # see DataParallelEngine
 
     def __post_init__(self):
         mesh = self.mesh
@@ -229,6 +256,7 @@ class DDPEngine:
         self._batch = NamedSharding(mesh, P(("data",)))
         bn_axis = "data" if self.sync_bn else None
         cdt = self.compute_dtype
+        tf = self.input_transform
         model = self.model
 
         @partial(
@@ -247,7 +275,9 @@ class DDPEngine:
                 lax.axis_index("data"),
             )
 
-            images_c = _cast_input(images, cdt)
+            images_c = _cast_input(
+                _apply_input_transform(tf, images, ts.step, True), cdt
+            )
 
             def loss_fn(params, model_state):
                 logits, new_state = model.apply(
@@ -283,8 +313,11 @@ class DDPEngine:
             check_vma=False,
         )
         def shard_eval(ts: TrainState, images, labels):
+            images_c = _cast_input(
+                _apply_input_transform(tf, images, ts.step, False), cdt
+            )
             logits, _ = self.model.apply(
-                ts.params, ts.model_state, _cast_input(images, cdt),
+                ts.params, ts.model_state, images_c,
                 Context(train=False, dtype=cdt),
             )
             loss = cross_entropy(logits, labels)

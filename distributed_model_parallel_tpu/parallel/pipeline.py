@@ -309,6 +309,32 @@ class PipelineEngine:
             _unpack(flat_host[i], avals[i]) for i in range(self.num_stages)
         )
 
+    def _opt_param_fields(self) -> dict:
+        """Which optimizer-state fields follow the params (and are
+        therefore packed (S, maxP) in stage-local mode) versus stay
+        replicated — read from the optimizer's own `state_shardings`
+        DECLARATION via a sentinel probe, NOT from shape or tuple-length
+        heuristics: a future field that merely *happens* to be shaped
+        (num_stages, psize), or a length-S tuple, must not silently
+        mis-serialize (ADVICE r3 #2)."""
+        p_mark, r_mark = object(), object()
+        decl = self.optimizer.state_shardings(p_mark, r_mark)
+        fields = {}
+        for k, v in decl._asdict().items():
+            if v is p_mark:
+                fields[k] = True
+            elif v is r_mark:
+                fields[k] = False
+            else:
+                raise ValueError(
+                    f"optimizer.state_shardings built field {k!r} from "
+                    f"neither the param-sharding pytree nor the "
+                    f"replicated sharding; PipelineEngine cannot infer "
+                    f"its checkpoint layout. Declare each field as one "
+                    f"of the two protocol arguments."
+                )
+        return fields
+
     def to_canonical(self, ts: TrainState) -> TrainState:
         """TrainState in the layout-independent checkpoint form: params /
         BN state / optimizer buffers as per-stage tuples of pytrees with
@@ -319,20 +345,21 @@ class PipelineEngine:
 
         Optimizer-state protocol: a NamedTuple whose fields are either
         param-shaped buffers (packed (S, maxP) here — SGD momentum,
-        AdamW moments) or replicated scalars (AdamW's count); the walk
-        below keys on which shape each field carries."""
+        AdamW moments) or replicated scalars (AdamW's count); which is
+        which comes from the optimizer's `state_shardings` declaration
+        (`_opt_param_fields`)."""
         if not self.stage_local_params:
             return ts
-        packed_shape = (self.num_stages, self._psize)
+        follows = self._opt_param_fields()
 
-        def canon_opt_field(v):
-            if getattr(v, "shape", None) == packed_shape:
+        def canon_opt_field(k, v):
+            if follows[k]:
                 return self._unpack_stages(_to_host(v), self._param_avals)
             return v
 
         opt_c = type(ts.opt_state)(
             **{
-                k: canon_opt_field(v)
+                k: canon_opt_field(k, v)
                 for k, v in ts.opt_state._asdict().items()
             }
         )
@@ -353,8 +380,10 @@ class PipelineEngine:
             [_pack_np(s, self._ssize) for s in ts.model_state]
         )
 
-        def pack_opt_field(v):
-            if isinstance(v, tuple) and len(v) == self.num_stages:
+        follows = self._opt_param_fields()
+
+        def pack_opt_field(k, v):
+            if follows[k]:
                 return self._stack_local(
                     [_pack_np(m, self._psize) for m in v]
                 )
@@ -362,7 +391,7 @@ class PipelineEngine:
 
         opt_p = type(ts.opt_state)(
             **{
-                k: pack_opt_field(v)
+                k: pack_opt_field(k, v)
                 for k, v in ts.opt_state._asdict().items()
             }
         )
@@ -438,10 +467,16 @@ class PipelineEngine:
             out_leaves = jax.tree_util.tree_leaves(avals[-1][1])
             if len(out_leaves) != 1 or len(out_leaves[0].shape) != 2:
                 raise ValueError(
-                    "last pipeline stage must output a single (batch, "
-                    f"classes) logits array, got {avals[-1][1]}"
+                    "last pipeline stage must output a single (rows, "
+                    f"classes) logits array, got {avals[-1][1]} — "
+                    "classification heads emit (microbatch, classes); "
+                    "token-level (LM) heads flatten to (microbatch*T, "
+                    "vocab) (models/gpt.py split_stages)"
                 )
-            num_classes = out_leaves[0].shape[-1]
+            # Logits rows per microbatch, from the traced aval — mb for
+            # classification heads, mb*T for token-level LM heads (whose
+            # labels arrive pre-flattened to (B*T,) so rows line up).
+            rows, num_classes = out_leaves[0].shape
             buf_size = max(_tree_size(out) for _, out in avals)
             wire_dt = _wire_dtype(avals)
             s_idx = lax.axis_index("stage")
@@ -506,8 +541,8 @@ class PipelineEngine:
                 # Logits stack stays f32 regardless of the wire dtype so
                 # the loss/metrics see the same precision on every path.
                 logits_mb = (
-                    y_pad[: mb * num_classes]
-                    .reshape(mb, num_classes)
+                    y_pad[: rows * num_classes]
+                    .reshape(rows, num_classes)
                     .astype(jnp.float32)
                 )
                 out_stack = lax.dynamic_update_index_in_dim(
@@ -527,13 +562,13 @@ class PipelineEngine:
                 return (buf, state, out_stack), None
 
             buf0 = jnp.zeros((buf_size,), wire_dt)
-            out0 = jnp.zeros((M, mb, num_classes), jnp.float32)
+            out0 = jnp.zeros((M, rows, num_classes), jnp.float32)
             (buf, new_state, out_stack), _ = lax.scan(
                 tick,
                 (buf0, model_state, out0),
                 jnp.arange(M + S - 1),
             )
-            logits = out_stack.reshape(n_local, num_classes)
+            logits = out_stack.reshape(M * rows, num_classes)
             # CE only counts on the last stage (the only device whose
             # out_stack holds real logits). NO psum here: the loss must stay
             # local so autodiff never transposes a cross-device reduction
@@ -599,11 +634,22 @@ class PipelineEngine:
             def step(ts: TrainState, images, labels, lr):
                 s_idx = lax.axis_index("stage")
 
+                # Normalize by the VALID row count (labels != -1), like
+                # the dense engines' cross_entropy mean: for LM heads
+                # that's per valid token (each sequence's final position
+                # and pad targets carry -1), for classification it is
+                # the unpadded batch — so gradient scale matches the
+                # dense convention for both head kinds and does not
+                # drift with the pad fraction. Local (this shard's
+                # labels), keeping the no-collectives-before-grad
+                # discipline.
+                loss_norm = jnp.maximum(valid_count(labels), 1.0)
+
                 def loss_fn(params):
                     loss_sum, aux = pipeline_forward(
                         params, ts.model_state, images, labels, ts.step
                     )
-                    return loss_sum / images.shape[0], aux
+                    return loss_sum / loss_norm, aux
 
                 (loss, (logits, new_state, is_last)), grads = (
                     jax.value_and_grad(loss_fn, has_aux=True)(ts.params)
@@ -632,7 +678,7 @@ class PipelineEngine:
                 new_ts = TrainState(
                     params, new_state, opt_state, ts.step + 1
                 )
-                loss_sum = loss * images.shape[0]
+                loss_sum = loss * loss_norm
                 return new_ts, metrics_from(logits, labels, loss_sum, is_last)
 
             return step

@@ -50,6 +50,38 @@ def _path_str(path) -> str:
     return "/".join(parts)
 
 
+def _needs_collective_gather(x) -> bool:
+    """True only for leaves genuinely SHARDED across processes (FSDP/TP
+    on a multi-host mesh). Fully-REPLICATED multi-host leaves report
+    is_fully_addressable=False too, but every host holds a complete
+    copy — a plain device_get suffices and must not pay (or synchronize
+    on) a collective."""
+    return (
+        isinstance(x, jax.Array)
+        and not x.is_fully_addressable
+        and not x.sharding.is_fully_replicated
+    )
+
+
+def _host_leaf(x):
+    """One leaf -> host numpy. A leaf sharded across processes (FSDP /
+    TP params and moments on a multi-host mesh) is all-gathered first;
+    replicated or single-host leaves fetch directly."""
+    if _needs_collective_gather(x):
+        from jax.experimental import multihost_utils
+
+        return np.asarray(multihost_utils.process_allgather(x, tiled=True))
+    return np.asarray(jax.device_get(x))
+
+
+def tree_to_host(tree: Any) -> Any:
+    """Pytree -> host numpy pytree, leaf by leaf (peak device memory
+    during the gather is ONE unsharded leaf, not the whole state — the
+    envelope ZeRO-3 cares about). This is the canonical checkpoint form
+    for the sharded engines (`TensorParallelEngine.to_canonical`)."""
+    return jax.tree_util.tree_map(_host_leaf, tree)
+
+
 def save_checkpoint(
     directory: str,
     train_state: Any,
@@ -59,16 +91,28 @@ def save_checkpoint(
     name: str = "ckpt",
     extra: Optional[dict] = None,
 ) -> str:
-    """Write `{directory}/{name}.npz` (+ `.json` metadata). Host-0 only —
+    """Write `{directory}/{name}.npz` (+ `.json` metadata). Host-0 writes —
     the reference likewise checkpoints from the process that owns the val
-    loop (`data_parallel.py:143-155`). Returns the npz path."""
-    if jax.process_index() != 0:
-        return os.path.join(directory, f"{name}.npz")
-    os.makedirs(directory, exist_ok=True)
+    loop (`data_parallel.py:143-155`) — but on a multi-process mesh EVERY
+    process must call this (the leaf gather for cross-process sharded
+    leaves is collective). Returns the npz path."""
     leaves_with_paths, _ = jax.tree_util.tree_flatten_with_path(train_state)
+    needs_gather = any(
+        _needs_collective_gather(leaf) for _, leaf in leaves_with_paths
+    )
+    if jax.process_index() != 0:
+        # Non-0 hosts participate ONLY in the collective gathers (leaf
+        # order matches host 0's walk); replicated/addressable leaves
+        # would be a pointless device->host copy here.
+        if needs_gather:
+            for _, leaf in leaves_with_paths:
+                if _needs_collective_gather(leaf):
+                    _host_leaf(leaf)
+        return os.path.join(directory, f"{name}.npz")
     arrays = {}
     for path, leaf in leaves_with_paths:
-        arrays[_path_str(path)] = np.asarray(jax.device_get(leaf))
+        arrays[_path_str(path)] = _host_leaf(leaf)
+    os.makedirs(directory, exist_ok=True)
     npz_path = os.path.join(directory, f"{name}.npz")
     tmp = npz_path + ".tmp"
     with open(tmp, "wb") as f:

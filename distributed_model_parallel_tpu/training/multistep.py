@@ -1,0 +1,78 @@
+"""Multi-step compiled dispatch — k optimizer steps per device program.
+
+The reference's hot loop (`utils.py:42-72`) is one CUDA launch sequence
+per Python iteration; CUDA's stream queue hides the per-step launch
+latency. On a JAX host whose accelerator sits behind a network relay the
+analogous per-step `jit` dispatch is NOT hidden: RESULTS §1c measured
+0.145-0.181 s/batch end-to-end against an AOT step rate of 0.0197 s —
+a 7-9x gap that is pure dispatch round-trip, not compute.
+
+`compile_multi_step(engine, k)` removes it structurally: ONE jitted
+program stacks k already-sharded batches and runs k sequential train
+steps under `lax.scan`, so the per-step trajectory (step counter,
+dropout folding, optimizer updates) is IDENTICAL to k separate
+`engine.train_step` calls — pinned by tests/test_trainer.py — while the
+host pays one dispatch per k steps. Batches still transfer
+asynchronously one by one (`shard_batch`), so input staging overlaps
+the previous group's compute.
+
+Works with any engine exposing the uniform protocol
+`train_step(state, x, y, lr) -> (state, metrics)`: the engine's own
+jitted step (jit- or shard_map-built) is traced inline into the scan
+body, keeping its sharding annotations as constraints.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def compile_multi_step(engine: Any, k: int) -> Callable:
+    """Build `fn(state, batches, lr) -> (state, summed_metrics)` running
+    `k` train steps in one compiled program.
+
+    `batches` is a tuple of `k` batch tuples as returned by
+    `engine.shard_batch` (already device-placed). The returned metrics
+    dict holds the SUM over the k steps of the engine's per-step metric
+    sums — the same value accumulating k per-step results would give.
+    """
+    if k < 2:
+        raise ValueError(f"steps_per_dispatch must be >= 2, got {k}")
+
+    def k_steps(state, batches: Tuple, lr):
+        # Leaf-wise stack of the k batch tuples -> scan operands with a
+        # leading step axis. Device-side: the k inputs were placed by
+        # shard_batch; the stack is a cheap on-device concatenation.
+        stacked = jax.tree_util.tree_map(
+            lambda *xs: jnp.stack(xs), *batches
+        )
+
+        def body(s, batch):
+            s2, m = engine.train_step(s, *batch, lr)
+            return s2, m
+
+        state, per_step = lax.scan(body, state, stacked)
+        return state, jax.tree_util.tree_map(
+            lambda x: jnp.sum(x, axis=0), per_step
+        )
+
+    return jax.jit(k_steps, donate_argnums=(0,))
+
+
+def group_batches(iterator, k: int):
+    """Pull up to `k` items from `iterator`; a short list means the
+    iterator was exhausted (the caller's per-step fallback path)."""
+    group = []
+    while len(group) < k:
+        try:
+            group.append(next(iterator))
+        except StopIteration:
+            break
+    return group
+
+
+__all__ = ["compile_multi_step", "group_batches"]

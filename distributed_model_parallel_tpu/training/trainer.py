@@ -41,6 +41,10 @@ from distributed_model_parallel_tpu.training.checkpoint import (
     restore_checkpoint,
     save_checkpoint,
 )
+from distributed_model_parallel_tpu.training.multistep import (
+    compile_multi_step,
+    group_batches,
+)
 from distributed_model_parallel_tpu.training.optim import (
     cosine_warmup_schedule,
 )
@@ -92,6 +96,14 @@ class TrainerConfig:
     # from it; `--resume` prefers it over the best-acc snapshot when it
     # is newer.
     save_last: bool = False
+    # Fold this many optimizer steps into ONE compiled dispatch
+    # (lax.scan over stacked batches — `training/multistep.py`). The
+    # training trajectory is bit-identical to per-step dispatch; what
+    # changes is the host->device round-trip count, the measured 7-9x
+    # end-to-end gap on a relay-attached accelerator (RESULTS §1c).
+    # Epoch tails shorter than the group fall back to per-step dispatch
+    # (one extra compile the first time a tail occurs). 1 = off.
+    steps_per_dispatch: int = 1
 
 
 class Trainer:
@@ -154,6 +166,7 @@ class Trainer:
                 )
         self.history: list[dict] = []
         self._profiled = False
+        self._multi = None  # lazily compiled k-step dispatch
 
     # ------------------------------------------------------------- loops
 
@@ -171,46 +184,93 @@ class Trainer:
         # Profile steps 10-12 of the first profiled epoch (past compile and
         # cache warmup); short smoke epochs profile from the first step so
         # the capture is never silently empty.
+        # Batches this epoch can actually yield: the loader length
+        # bounded by the steps_per_epoch truncation (None = unknown).
+        # One source of truth for the profiler window AND the dispatch
+        # clamp below.
+        n_avail = (
+            len(self.train_loader)
+            if hasattr(self.train_loader, "__len__") else None
+        )
+        if cfg.steps_per_epoch:
+            n_avail = (
+                min(n_avail, cfg.steps_per_epoch)
+                if n_avail else cfg.steps_per_epoch
+            )
         profile_at = None
         if cfg.profile_dir and not self._profiled:
-            n_avail = cfg.steps_per_epoch or (
-                len(self.train_loader)
-                if hasattr(self.train_loader, "__len__")
-                else None
-            )
             profile_at = 10 if (n_avail is None or n_avail > 12) else 0
         profiling = False
+        k = max(1, cfg.steps_per_dispatch)
+        if n_avail is not None and k > n_avail:
+            # A group larger than the epoch would NEVER fill, silently
+            # degrading every epoch to per-step dispatch (the gap this
+            # feature exists to close) — clamp so at least one fused
+            # dispatch runs per epoch.
+            if not getattr(self, "_warned_k_clamp", False):
+                self._log_print(
+                    f"==> steps_per_dispatch {k} exceeds the "
+                    f"{n_avail}-batch epoch; clamping to {n_avail}"
+                )
+                self._warned_k_clamp = True
+            k = max(1, n_avail)
         epoch_start = time.perf_counter()
         while True:
-            if cfg.steps_per_epoch and n_batches >= cfg.steps_per_epoch:
-                break
+            want = k
+            if cfg.steps_per_epoch:
+                want = min(k, cfg.steps_per_epoch - n_batches)
+                if want <= 0:
+                    break
             t0 = time.perf_counter()
-            try:
-                images, labels = next(it)
-            except StopIteration:
-                break
+            host_batches = group_batches(it, want)
             data_time += time.perf_counter() - t0
-            if profile_at is not None and n_batches == profile_at:
+            if not host_batches:
+                break
+            placed = [self.engine.shard_batch(*b) for b in host_batches]
+            if (
+                profile_at is not None
+                and not profiling
+                and n_batches + len(placed) > profile_at
+            ):
                 jax.block_until_ready(self.state)  # trace excludes backlog
                 jax.profiler.start_trace(cfg.profile_dir)
                 profiling = True
-            images, labels = self.engine.shard_batch(images, labels)
-            self.state, metrics = self.engine.train_step(
-                self.state, images, labels, lr
-            )
-            if profiling and n_batches >= profile_at + 2:
+            if len(placed) == k and k > 1:
+                # One dispatch, k steps (trajectory identical to the
+                # per-step path — tests/test_trainer.py pins it).
+                if self._multi is None:
+                    self._multi = compile_multi_step(self.engine, k)
+                self.state, metrics = self._multi(
+                    self.state, tuple(placed), lr
+                )
+            else:
+                metrics = None
+                for b in placed:
+                    self.state, m_i = self.engine.train_step(
+                        self.state, *b, lr
+                    )
+                    metrics = (
+                        m_i
+                        if metrics is None
+                        else jax.tree_util.tree_map(jnp.add, metrics, m_i)
+                    )
+            prev = n_batches
+            n_batches += len(placed)
+            if profiling and n_batches >= profile_at + 3:
                 jax.block_until_ready(self.state)
                 jax.profiler.stop_trace()
                 profiling = False
                 self._profiled = True
+                profile_at = None  # never re-arm within this epoch
             sums = (
                 metrics
                 if sums is None
                 else jax.tree_util.tree_map(jnp.add, sums, metrics)
             )
-            n_batches += 1
-            if cfg.print_freq and n_batches % cfg.print_freq == 0:
-                m = jax.device_get(metrics)  # fences this step
+            if cfg.print_freq and (
+                n_batches // cfg.print_freq > prev // cfg.print_freq
+            ):
+                m = jax.device_get(metrics)  # fences this dispatch
                 self._log_print(
                     f"Epoch: [{epoch}][{n_batches}/{len(self.train_loader)}]"
                     f"\tLoss {m['loss_sum'] / m['count']:.4e}"

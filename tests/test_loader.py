@@ -54,3 +54,38 @@ def test_epoch_shuffle_is_deterministic_and_host_consistent():
     # Determinism: re-iterating the same epoch gives identical batches.
     la2 = np.concatenate([lb for _, lb in a])
     np.testing.assert_array_equal(la, la2)
+
+
+def test_device_normalize_yields_uint8_with_identical_augment_draws():
+    """device_normalize ships augmented uint8; applying the device
+    normalizer must reproduce the host-normalized batch bit-for-bit
+    (same keyed crop/flip draws, same /255-mean/std math)."""
+    import pytest
+
+    from distributed_model_parallel_tpu.data.datasets import (
+        CIFAR10_MEAN,
+        CIFAR10_STD,
+    )
+    from distributed_model_parallel_tpu.data.loader import device_normalizer
+
+    ds = synthetic(num_examples=64, num_classes=4, image_size=8, seed=1)
+    kw = dict(batch_size=16, shuffle=True, augment=True, seed=7,
+              mean=CIFAR10_MEAN, std=CIFAR10_STD, use_native=False)
+    host = Loader(ds, **kw)
+    dev = Loader(ds, device_normalize=True, **kw)
+    tf = device_normalizer(CIFAR10_MEAN, CIFAR10_STD)
+    n = 0
+    for (hb, hl), (db, dl) in zip(host, dev):
+        assert db.dtype == np.uint8
+        np.testing.assert_array_equal(hl, dl)
+        np.testing.assert_allclose(
+            np.asarray(tf(db)), hb, rtol=1e-6, atol=1e-6
+        )
+        n += 1
+    assert n == 4
+
+    # The native hot loop is host-side fused augment+normalize; asking
+    # for both must refuse loudly, not silently normalize twice.
+    with pytest.raises(ValueError, match="device_normalize"):
+        Loader(ds, device_normalize=True, use_native=True,
+               batch_size=16, mean=CIFAR10_MEAN, std=CIFAR10_STD)

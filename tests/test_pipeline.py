@@ -443,3 +443,76 @@ def test_pipeline_gradients_equal_pure_jax_grad(pp_mesh, stage_local):
                 g, np.asarray(w), rtol=2e-4, atol=1e-6,
                 err_msg=f"stage {i} {jax.tree_util.keystr(path)}",
             )
+
+
+def test_opt_field_classification_uses_declaration(pp_mesh):
+    """Regression for the shape-heuristic hazard (ADVICE r3 #2): an
+    optimizer field that HAPPENS to be shaped exactly like the packed
+    (num_stages, psize) buffer but is declared replicated must survive
+    to_canonical/from_canonical untouched — the walk keys on the
+    optimizer's state_shardings declaration, not on shapes. A
+    declaration that uses neither protocol argument raises."""
+    from typing import Any, NamedTuple
+
+    class TrapState(NamedTuple):
+        momentum: Any  # param-following (packed in stage-local mode)
+        aux: Any       # replicated — but shaped (S, psize) by malice
+
+    class TrapSGD:
+        def init(self, params):
+            mom = jax.tree_util.tree_map(jnp.zeros_like, params)
+            leaves = jax.tree_util.tree_leaves(params)
+            aux = (
+                jnp.full(leaves[0].shape, 7.0, jnp.float32)
+                if leaves else jnp.zeros(())
+            )
+            return TrapState(mom, aux)
+
+        def update(self, params, state, grads, lr):
+            mom = jax.tree_util.tree_map(
+                lambda m, g: 0.9 * m + g, state.momentum, grads
+            )
+            new_p = jax.tree_util.tree_map(
+                lambda p, m: p - lr * m, params, mom
+            )
+            return new_p, TrapState(mom, state.aux)
+
+        def state_shardings(self, param_shardings, replicated):
+            return TrapState(param_shardings, replicated)
+
+    eng = PipelineEngine(
+        tiny_stages(), TrapSGD(), pp_mesh, num_microbatches=2,
+        donate=False, stage_local_params=True,
+    )
+    assert eng._opt_param_fields() == {"momentum": True, "aux": False}
+    ts = eng.init_state(jax.random.PRNGKey(0))
+    images, labels = batch(n=16, hw=8, seed=11)
+    ts, _ = eng.train_step(
+        ts, *eng.shard_batch(images, labels), jnp.float32(0.05)
+    )
+    assert ts.opt_state.aux.shape == (4, eng._psize)  # the trap shape
+
+    canon = eng.to_canonical(ts)
+    # momentum unpacks to per-stage pytrees; aux must stay ONE array.
+    assert isinstance(canon.opt_state.momentum, tuple)
+    assert len(canon.opt_state.momentum) == 4
+    assert getattr(canon.opt_state.aux, "shape", None) == (4, eng._psize)
+    np.testing.assert_allclose(np.asarray(canon.opt_state.aux), 7.0)
+
+    ts2 = eng.from_canonical(canon)
+    assert ts2.opt_state.aux.shape == (4, eng._psize)
+    ts3, _ = eng.train_step(
+        ts2, *eng.shard_batch(images, labels), jnp.float32(0.05)
+    )
+    assert int(ts3.step) == int(ts.step) + 1
+
+    class BadDecl(TrapSGD):
+        def state_shardings(self, param_shardings, replicated):
+            return TrapState(param_shardings, "weird")
+
+    bad = PipelineEngine(
+        tiny_stages(), BadDecl(), pp_mesh, num_microbatches=2,
+        donate=False, stage_local_params=True,
+    )
+    with pytest.raises(ValueError, match="state_shardings"):
+        bad._opt_param_fields()

@@ -97,6 +97,124 @@ def test_checkpoint_missing_raises(engine, tmp_path):
         restore_checkpoint(str(tmp_path / "nope"), state)
 
 
+def test_multi_step_dispatch_matches_per_step(tmp_path):
+    """steps_per_dispatch folds k steps into one lax.scan program; the
+    trajectory (losses, accs, final params) must be IDENTICAL to per-step
+    dispatch — it is the same math, only the dispatch count changes.
+    7 batches with k=3 also exercises the short-tail fallback (3+3+1)."""
+    train, val = loaders(n=224, batch=32)  # 7 train batches/epoch
+    mesh = make_mesh(MeshSpec(data=8))
+    common = dict(
+        epochs=2, base_lr=0.1, t_max=2, warmup_period=1, print_freq=0,
+        log_dir=str(tmp_path / "log"), checkpoint_dir=str(tmp_path / "ck"),
+        save_best=False,
+    )
+    results = {}
+    for k in (1, 3):
+        eng = DataParallelEngine(
+            model=tiny_model(), optimizer=SGD(), mesh=mesh
+        )
+        t = Trainer(
+            eng, train, val, TrainerConfig(steps_per_dispatch=k, **common),
+            rng=jax.random.PRNGKey(0),
+        )
+        t.fit()
+        results[k] = (t.history, t.state)
+    for h1, h3 in zip(results[1][0], results[3][0]):
+        assert h1["train"]["count"] == h3["train"]["count"] == 224
+        np.testing.assert_allclose(
+            h1["train"]["loss"], h3["train"]["loss"], rtol=1e-5
+        )
+        np.testing.assert_allclose(
+            h1["train"]["acc1"], h3["train"]["acc1"], atol=1e-3
+        )
+        np.testing.assert_allclose(
+            h1["val"]["loss"], h3["val"]["loss"], rtol=1e-5
+        )
+    for (path, a), b in zip(
+        jax.tree_util.tree_leaves_with_path(results[1][1].params),
+        jax.tree_util.tree_leaves(results[3][1].params),
+    ):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=1e-5, atol=1e-6,
+            err_msg=jax.tree_util.keystr(path),
+        )
+
+
+def test_multi_step_dispatch_with_shard_map_engine(tmp_path):
+    """The k-step scan must also trace shard_map-built steps (DDPEngine):
+    explicit collectives inside a scan body, one dispatch per group."""
+    from distributed_model_parallel_tpu.parallel.data_parallel import (
+        DDPEngine,
+    )
+
+    train, val = loaders(n=128, batch=32)
+    mesh = make_mesh(MeshSpec(data=8))
+    eng = DDPEngine(model=tiny_model(), optimizer=SGD(), mesh=mesh)
+    cfg = TrainerConfig(
+        epochs=2, base_lr=0.1, t_max=2, warmup_period=1, print_freq=0,
+        log_dir=str(tmp_path / "log"), checkpoint_dir=str(tmp_path / "ck"),
+        save_best=False, steps_per_dispatch=2,
+    )
+    t = Trainer(eng, train, val, cfg, rng=jax.random.PRNGKey(0))
+    result = t.fit()
+    hist = result["history"]
+    assert hist[0]["train"]["count"] == 128
+    assert hist[-1]["train"]["loss"] < hist[0]["train"]["loss"]
+
+
+def test_device_normalize_trainer_matches_host_normalize(tmp_path):
+    """The uint8-transfer + on-device-normalize path must follow the same
+    trajectory as host-side normalization: same augment draws (keyed RNG),
+    same normalize math, only the placement of the arithmetic moves."""
+    from distributed_model_parallel_tpu.data.datasets import (
+        CIFAR10_MEAN,
+        CIFAR10_STD,
+    )
+    from distributed_model_parallel_tpu.data.loader import device_normalizer
+
+    ds = synthetic(num_examples=128, num_classes=4, image_size=8, seed=0)
+    mesh = make_mesh(MeshSpec(data=8))
+    common = dict(
+        epochs=1, base_lr=0.1, t_max=1, warmup_period=1, print_freq=0,
+        log_dir=str(tmp_path / "log"), checkpoint_dir=str(tmp_path / "ck"),
+        save_best=False,
+    )
+    histories = {}
+    for dev_norm in (False, True):
+        loader_kw = dict(
+            batch_size=32, shuffle=True, augment=True,
+            mean=CIFAR10_MEAN, std=CIFAR10_STD, seed=0, use_native=False,
+        )
+        eng = DataParallelEngine(
+            model=tiny_model(), optimizer=SGD(), mesh=mesh,
+            input_transform=(
+                device_normalizer(CIFAR10_MEAN, CIFAR10_STD)
+                if dev_norm else None
+            ),
+        )
+        train = Loader(ds, device_normalize=dev_norm, **loader_kw)
+        val = Loader(
+            ds, batch_size=32, shuffle=False, augment=False,
+            mean=CIFAR10_MEAN, std=CIFAR10_STD,
+            device_normalize=dev_norm, use_native=False,
+        )
+        t = Trainer(eng, train, val, TrainerConfig(**common),
+                    rng=jax.random.PRNGKey(0))
+        t.fit()
+        histories[dev_norm] = t.history
+    h_host, h_dev = histories[False][0], histories[True][0]
+    np.testing.assert_allclose(
+        h_host["train"]["loss"], h_dev["train"]["loss"], rtol=1e-5
+    )
+    np.testing.assert_allclose(
+        h_host["val"]["loss"], h_dev["val"]["loss"], rtol=1e-5
+    )
+    np.testing.assert_allclose(
+        h_host["val"]["acc1"], h_dev["val"]["acc1"], atol=1e-3
+    )
+
+
 def test_resume_continues_from_epoch(engine, tmp_path):
     train, val = loaders(n=128)
     common = dict(
