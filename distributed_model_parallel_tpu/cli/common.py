@@ -112,7 +112,7 @@ def build_model(name: str, num_classes: int, *, remat: bool = False):
 
 
 def stats_for(dataset_type: str) -> Tuple[np.ndarray, np.ndarray]:
-    if dataset_type in ("CIFAR10", "Synthetic"):
+    if dataset_type in ("CIFAR10", "Synthetic", "SyntheticTextures"):
         return CIFAR10_MEAN, CIFAR10_STD
     return IMAGENET_MEAN, IMAGENET_STD
 

@@ -60,9 +60,17 @@ class DeviceDatasetCache:
         import jax
         from jax.sharding import NamedSharding, PartitionSpec as P
 
-        images = (
-            dataset if isinstance(dataset, np.ndarray) else dataset.images
-        )
+        if isinstance(dataset, np.ndarray):
+            images = dataset
+        elif hasattr(dataset, "images"):
+            images = dataset.images
+        else:
+            raise ValueError(
+                f"device cache needs an in-memory dataset (ArrayDataset "
+                f"or ndarray); got {type(dataset).__name__} — lazy "
+                f"disk-backed datasets (ImageFolder trees) keep the host "
+                f"Loader path"
+            )
         if images.nbytes > max_bytes:
             raise ValueError(
                 f"dataset is {images.nbytes / 1e9:.1f} GB uint8 — beyond "
@@ -162,6 +170,13 @@ def combined_cache(
     only under train=True). Returns `(transform, val_offset)` — build
     the val `IndexLoader` with `index_offset=val_offset` so its indices
     address the val block of the combined cache."""
+    for which, ds in (("train", train_ds), ("val", val_ds)):
+        if not hasattr(ds, "images"):
+            raise ValueError(
+                f"device cache needs in-memory datasets; the {which} "
+                f"split is a {type(ds).__name__} (lazy disk-backed) — "
+                f"use the host Loader path for it"
+            )
     images = np.concatenate([train_ds.images, val_ds.images])
     cache = DeviceDatasetCache(
         images, mesh, augment=augment, mean=mean, std=std,

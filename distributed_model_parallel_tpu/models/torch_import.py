@@ -158,6 +158,85 @@ def mobilenetv2_from_torch_state_dict(
     return params, state
 
 
+def mobilenetv2_to_torch_state_dict(
+    params: Any, state: Any, *, module_prefix: bool = True
+) -> Dict[str, np.ndarray]:
+    """The INVERSE bridge: a `mobilenet_v2(...)` (params, state) pair ->
+    the reference's torch `state_dict` schema (OIHW convs, `module.*`
+    prefixes as `nn.DataParallel` saves them — `data_parallel.py:146-151`).
+    Round-trips bit-exactly through `mobilenetv2_from_torch_state_dict`
+    (tests/test_torch_import.py), so a model trained HERE can be handed
+    back to the reference code (or to this framework's own `--finetune`
+    flag, which expects the reference format)."""
+    import jax
+
+    params = jax.tree_util.tree_map(np.asarray, params)
+    state = jax.tree_util.tree_map(np.asarray, state)
+    sd: Dict[str, np.ndarray] = {}
+
+    def conv_w(t):  # HWIO -> OIHW
+        return np.ascontiguousarray(np.transpose(t, (3, 2, 0, 1)))
+
+    def put_bn(prefix, p, s):
+        sd[f"{prefix}.weight"] = p["scale"]
+        sd[f"{prefix}.bias"] = p["bias"]
+        sd[f"{prefix}.running_mean"] = s["mean"]
+        sd[f"{prefix}.running_var"] = s["var"]
+        sd[f"{prefix}.num_batches_tracked"] = np.asarray(0, np.int64)
+
+    sd["conv1.weight"] = conv_w(params["stem"]["conv1"]["w"])
+    put_bn("bn1", params["stem"]["bn1"], state["stem"]["bn1"])
+
+    in_planes, i = 32, 0
+    for expansion, out_planes, num_blocks, stride in CFG:
+        for s_ in [stride] + [1] * (num_blocks - 1):
+            dst = f"layers.{i}"
+            src_p = params["blocks"][str(i)]
+            src_s = state["blocks"][str(i)]
+            has_residual = s_ == 1
+            body_p = src_p["body"] if has_residual else src_p
+            body_s = src_s["body"] if has_residual else src_s
+            for conv, bn in (("conv1", "bn1"), ("conv2", "bn2"),
+                             ("conv3", "bn3")):
+                sd[f"{dst}.{conv}.weight"] = conv_w(body_p[conv]["w"])
+                put_bn(f"{dst}.{bn}", body_p[bn], body_s[bn])
+            if has_residual and in_planes != out_planes:
+                sd[f"{dst}.shortcut.0.weight"] = conv_w(
+                    src_p["shortcut"]["conv"]["w"]
+                )
+                put_bn(f"{dst}.shortcut.1", src_p["shortcut"]["bn"],
+                       src_s["shortcut"]["bn"])
+            in_planes = out_planes
+            i += 1
+
+    sd["conv2.weight"] = conv_w(params["head"]["conv2"]["w"])
+    put_bn("bn2", params["head"]["bn2"], state["head"]["bn2"])
+    sd["linear.weight"] = np.ascontiguousarray(
+        params["head"]["linear"]["w"].T
+    )
+    sd["linear.bias"] = params["head"]["linear"]["b"]
+    if module_prefix:
+        sd = {f"module.{k}": v for k, v in sd.items()}
+    return sd
+
+
+def save_reference_checkpoint(
+    path: str, params: Any, state: Any, *, acc: float = 0.0,
+    epoch: int = 0,
+) -> str:
+    """Write the reference's exact checkpoint schema
+    `{'net': module.* state_dict, 'acc': acc, 'epoch': epoch}`
+    (`data_parallel.py:146-151`) as a torch `.pth`."""
+    import torch
+
+    sd = {
+        k: torch.from_numpy(np.ascontiguousarray(v))
+        for k, v in mobilenetv2_to_torch_state_dict(params, state).items()
+    }
+    torch.save({"net": sd, "acc": acc, "epoch": epoch}, path)
+    return path
+
+
 def load_torch_checkpoint(path: str) -> Dict[str, Any]:
     """Read a torch `.pth`/`.pt` (via torch, CPU) or `.npz` checkpoint
     into a plain dict ready for `mobilenetv2_from_torch_state_dict`."""

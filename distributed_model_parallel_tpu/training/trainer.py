@@ -98,7 +98,9 @@ class TrainerConfig:
     save_last: bool = False
     # Fold this many optimizer steps into ONE compiled dispatch
     # (lax.scan over stacked batches — `training/multistep.py`). The
-    # training trajectory is bit-identical to per-step dispatch; what
+    # training trajectory matches per-step dispatch to numerical
+    # tolerance (same math; XLA may fuse across step boundaries
+    # differently — pinned at rtol 1e-5 in tests/test_trainer.py); what
     # changes is the host->device round-trip count, the measured 7-9x
     # end-to-end gap on a relay-attached accelerator (RESULTS §1c).
     # Epoch tails shorter than the group fall back to per-step dispatch
@@ -230,8 +232,12 @@ class Trainer:
             if (
                 profile_at is not None
                 and not profiling
-                and n_batches + len(placed) > profile_at
+                and n_batches >= profile_at
             ):
+                # Arm on the first dispatch whose START is past the
+                # warmup threshold — a group that merely SPANS it would
+                # capture the k-step program's trace+compile, the cost
+                # the offset exists to exclude.
                 jax.block_until_ready(self.state)  # trace excludes backlog
                 jax.profiler.start_trace(cfg.profile_dir)
                 profiling = True
