@@ -199,9 +199,6 @@ class Trainer:
                 min(n_avail, cfg.steps_per_epoch)
                 if n_avail else cfg.steps_per_epoch
             )
-        profile_at = None
-        if cfg.profile_dir and not self._profiled:
-            profile_at = 10 if (n_avail is None or n_avail > 12) else 0
         profiling = False
         k = max(1, cfg.steps_per_dispatch)
         if n_avail is not None and k > n_avail:
@@ -216,6 +213,20 @@ class Trainer:
                 )
                 self._warned_k_clamp = True
             k = max(1, n_avail)
+        profile_at = None
+        if cfg.profile_dir and not self._profiled:
+            profile_at = 10 if (n_avail is None or n_avail > 12) else 0
+            if profile_at and k > 1:
+                # Dispatches happen at group granularity: arm at the
+                # first group START past the warmup threshold so the
+                # capture excludes the fused program's trace+compile.
+                # When no later group exists (the epoch fits in one),
+                # fall back to profiling the first dispatch — capturing
+                # compile beats an empty trace directory.
+                ga = ((profile_at + k - 1) // k) * k
+                profile_at = (
+                    ga if (n_avail is None or ga < n_avail) else 0
+                )
         epoch_start = time.perf_counter()
         while True:
             want = k
@@ -242,8 +253,8 @@ class Trainer:
                 jax.profiler.start_trace(cfg.profile_dir)
                 profiling = True
             if len(placed) == k and k > 1:
-                # One dispatch, k steps (trajectory identical to the
-                # per-step path — tests/test_trainer.py pins it).
+                # One dispatch, k steps (trajectory matches the per-step
+                # path to numerical tolerance — tests/test_trainer.py).
                 if self._multi is None:
                     self._multi = compile_multi_step(self.engine, k)
                 self.state, metrics = self._multi(
