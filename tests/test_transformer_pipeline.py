@@ -155,7 +155,73 @@ def test_model_parallel_cli_bert_tiny(tmp_path, monkeypatch):
         "--microbatches", "2",
         "--epochs", "1",
         "--steps-per-epoch", "2",
+        "--steps-per-dispatch", "2",  # flag plumbing through the CLI
         "--lr", "0.05",
     ])
     assert len(result["history"]) == 1
     assert np.isfinite(result["history"][0]["train"]["loss"])
+
+
+def test_pipeline_engine_multi_step_dispatch(pp_mesh, tmp_path):
+    """The engine path behind the model-parallel CLI's
+    --steps-per-dispatch: Trainer folds PipelineEngine steps through
+    compile_multi_step, so the k-step scan must trace the pipeline's
+    shard_map program (ppermute chains inside a scan body). The CLI
+    flag plumbing itself is covered by
+    test_model_parallel_cli_bert_tiny."""
+    from distributed_model_parallel_tpu.data.datasets import (
+        synthetic_text,
+    )
+    from distributed_model_parallel_tpu.training.trainer import (
+        Trainer,
+        TrainerConfig,
+    )
+    from distributed_model_parallel_tpu.data.loader import Loader
+
+    ds = synthetic_text(128, T, 4, vocab_size=BERT_CFG.vocab_size,
+                        seed=2)
+    stages = bert.split_stages(4, 4, BERT_CFG)
+    eng = PipelineEngine(
+        stages, SGD(momentum=0.9), pp_mesh, num_microbatches=2,
+        donate=False,
+    )
+    train = Loader(ds, batch_size=16, shuffle=True, seed=0, raw=True)
+    cfg = TrainerConfig(
+        epochs=1, base_lr=0.05, t_max=1, warmup_period=1, print_freq=0,
+        log_dir=str(tmp_path / "log"), checkpoint_dir=str(tmp_path / "ck"),
+        save_best=False, steps_per_dispatch=2, steps_per_epoch=4,
+    )
+    t = Trainer(eng, train, None, cfg, rng=jax.random.PRNGKey(0))
+    out = t.fit()
+    h = out["history"][0]["train"]
+    assert h["count"] == 64 and np.isfinite(h["loss"])
+
+
+def test_sp_engine_multi_step_dispatch():
+    """compile_multi_step over the sequence-parallel engine (the LM
+    CLI's --steps-per-dispatch engine path): ring ppermutes must trace
+    inside the scan body."""
+    from distributed_model_parallel_tpu.parallel.sequence_parallel import (
+        CausalLMSequenceParallelEngine,
+    )
+    from distributed_model_parallel_tpu.runtime.mesh import (
+        MeshSpec,
+        make_mesh,
+    )
+    from distributed_model_parallel_tpu.training.multistep import (
+        compile_multi_step,
+    )
+
+    mesh = make_mesh(MeshSpec(data=2, seq=4))
+    eng = CausalLMSequenceParallelEngine(GPT_CFG, SGD(), mesh,
+                                         donate=False)
+    ts = eng.init_state(jax.random.PRNGKey(0))
+    rng = np.random.RandomState(5)
+    batches = tuple(
+        eng.shard_batch(rng.randint(1, 61, size=(8, T)).astype(np.int32))
+        for _ in range(2)
+    )
+    multi = compile_multi_step(eng, 2)
+    ts, m = multi(ts, batches, jnp.float32(0.1))
+    assert np.isfinite(float(m["loss_sum"]))
+    assert int(ts.step) == 2
