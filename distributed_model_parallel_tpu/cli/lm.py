@@ -73,6 +73,12 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--seq-shards", default=1, type=int,
                    help="'seq' mesh axis size (context parallelism); "
                         "1 = plain data parallelism")
+    p.add_argument("--pipeline-stages", default=1, type=int,
+                   help="pipeline-parallel LM over the 'stage' axis "
+                        "(models/gpt.py split_stages + LMPipelineEngine);"
+                        " mutually exclusive with --seq-shards > 1")
+    p.add_argument("--microbatches", default=1, type=int,
+                   help="GPipe microbatches (pipeline mode)")
     p.add_argument("--attention", default="ring",
                    choices=("ring", "ring_flash", "ulysses",
                             "ulysses_flash"),
@@ -94,8 +100,34 @@ def build_parser() -> argparse.ArgumentParser:
 def main(argv=None) -> dict:
     args = build_parser().parse_args(argv)
     initialize_backend()
-    mesh = make_mesh(MeshSpec(data=-1, seq=args.seq_shards))
-    check_batch_divisibility(args.batch_size, mesh)
+    if args.pipeline_stages > 1 and args.seq_shards > 1:
+        raise SystemExit(
+            "--pipeline-stages and --seq-shards are mutually exclusive "
+            "(one engine per run; compose data parallelism with either)"
+        )
+    if args.pipeline_stages > 1 and args.attention != "ring":
+        # The --attention choices are 'seq'-axis DISTRIBUTION patterns;
+        # pipeline stages attend locally (dense causal). Silently
+        # training dense while the flag promises a flash kernel would
+        # mislabel every number the run produces.
+        raise SystemExit(
+            "--attention selects the sequence-parallel distribution "
+            "and has no effect under --pipeline-stages (stages attend "
+            "locally, dense causal); drop the flag"
+        )
+    if args.pipeline_stages <= 1 and args.microbatches != 1:
+        raise SystemExit(
+            "--microbatches is a pipeline-schedule knob; it has no "
+            "effect without --pipeline-stages > 1"
+        )
+    if args.pipeline_stages > 1:
+        mesh = make_mesh(MeshSpec(data=-1, stage=args.pipeline_stages))
+        check_batch_divisibility(
+            args.batch_size, mesh, microbatches=args.microbatches
+        )
+    else:
+        mesh = make_mesh(MeshSpec(data=-1, seq=args.seq_shards))
+        check_batch_divisibility(args.batch_size, mesh)
     if args.seq_len % args.seq_shards:
         raise SystemExit(
             f"--seq-len {args.seq_len} not divisible by --seq-shards "
@@ -111,11 +143,27 @@ def main(argv=None) -> dict:
         dropout_rate=args.dropout,
         pad_token_id=0,
     )
-    engine = CausalLMSequenceParallelEngine(
-        cfg, build_optimizer(args), mesh, attention=args.attention,
-        compute_dtype=compute_dtype_from_flag(args.dtype),
-        remat=args.remat,
-    )
+    if args.pipeline_stages > 1:
+        from distributed_model_parallel_tpu.models.gpt import split_stages
+        from distributed_model_parallel_tpu.parallel.pipeline import (
+            LMPipelineEngine,
+        )
+
+        engine = LMPipelineEngine(
+            split_stages(args.pipeline_stages, cfg),
+            build_optimizer(args),
+            mesh,
+            num_microbatches=args.microbatches,
+            compute_dtype=compute_dtype_from_flag(args.dtype),
+            remat=args.remat,
+            pad_token_id=cfg.pad_token_id,
+        )
+    else:
+        engine = CausalLMSequenceParallelEngine(
+            cfg, build_optimizer(args), mesh, attention=args.attention,
+            compute_dtype=compute_dtype_from_flag(args.dtype),
+            remat=args.remat,
+        )
     corpus = synthetic_corpus(
         args.vocab_size, args.corpus_tokens, seed=args.corpus_seed
     )

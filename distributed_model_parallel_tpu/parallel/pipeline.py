@@ -697,3 +697,27 @@ class PipelineEngine:
             return metrics_from(logits, labels, loss_sum, is_last)
 
         return evstep
+
+
+@dataclasses.dataclass
+class LMPipelineEngine(PipelineEngine):
+    """PipelineEngine for decoder-LM stages (`models/gpt.py
+    split_stages`): `shard_batch` derives the flattened next-token
+    targets from the ids on the HOST (`gpt.lm_targets` — the final
+    position and pad targets carry -1, masked by the loss), so the
+    uniform `(inputs, labels)` loader contract — `data/lm.py LMLoader`
+    yields `(ids, ids)` — drives LM training unchanged. The engine's
+    (rows, vocab) last-stage contract and valid-count loss normalization
+    make gradients match the dense `lm_loss` convention."""
+
+    pad_token_id: Any = None
+
+    def shard_batch(self, ids, labels=None):
+        import numpy as np
+
+        from distributed_model_parallel_tpu.models.gpt import lm_targets
+
+        targets = lm_targets(ids, self.pad_token_id).reshape(-1)
+        return _place_batch(
+            (np.asarray(ids, np.int32), targets), self._batch
+        )

@@ -225,3 +225,39 @@ def test_sp_engine_multi_step_dispatch():
     ts, m = multi(ts, batches, jnp.float32(0.1))
     assert np.isfinite(float(m["loss_sum"]))
     assert int(ts.step) == 2
+
+
+def test_lm_cli_pipeline_stages(tmp_path, monkeypatch):
+    """GPT-LM pipeline drivable end to end from the LM CLI:
+    --pipeline-stages 4 builds gpt.split_stages + LMPipelineEngine."""
+    from distributed_model_parallel_tpu.cli import lm as lm_cli
+
+    monkeypatch.chdir(tmp_path)
+    result = lm_cli.main([
+        "--vocab-size", "61", "--dim", "32", "--layers", "4",
+        "--heads", "4", "--ffn-dim", "64", "--seq-len", "16",
+        "-b", "16", "--epochs", "1", "--steps-per-epoch", "2",
+        "--lr", "1e-3", "--pipeline-stages", "4", "--microbatches", "2",
+    ])
+    assert len(result["history"]) == 1
+    assert np.isfinite(result["history"][0]["train"]["loss"])
+    # exclusivity guard
+    with pytest.raises(SystemExit, match="mutually exclusive"):
+        lm_cli.main([
+            "--pipeline-stages", "4", "--seq-shards", "2",
+            "--seq-len", "16", "-b", "16",
+        ])
+
+
+def test_lm_cli_pipeline_flag_guards(tmp_path, monkeypatch):
+    """Flags that would silently do nothing must refuse at startup."""
+    from distributed_model_parallel_tpu.cli import lm as lm_cli
+
+    monkeypatch.chdir(tmp_path)
+    with pytest.raises(SystemExit, match="no effect under"):
+        lm_cli.main([
+            "--pipeline-stages", "4", "--attention", "ulysses_flash",
+            "--seq-len", "16", "-b", "16",
+        ])
+    with pytest.raises(SystemExit, match="pipeline-schedule knob"):
+        lm_cli.main(["--microbatches", "8", "--seq-len", "16", "-b", "16"])
