@@ -120,6 +120,16 @@ def main(argv=None) -> dict:
             "--microbatches is a pipeline-schedule knob; it has no "
             "effect without --pipeline-stages > 1"
         )
+    if args.microbatches < 1:
+        raise SystemExit(
+            f"--microbatches must be >= 1, got {args.microbatches}"
+        )
+    if args.pipeline_stages > 1 and args.pipeline_stages > args.layers:
+        raise SystemExit(
+            f"--pipeline-stages {args.pipeline_stages} exceeds "
+            f"--layers {args.layers}: a stage needs at least one "
+            f"decoder block"
+        )
     if args.pipeline_stages > 1:
         mesh = make_mesh(MeshSpec(data=-1, stage=args.pipeline_stages))
         check_batch_divisibility(

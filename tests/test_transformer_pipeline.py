@@ -261,3 +261,15 @@ def test_lm_cli_pipeline_flag_guards(tmp_path, monkeypatch):
         ])
     with pytest.raises(SystemExit, match="pipeline-schedule knob"):
         lm_cli.main(["--microbatches", "8", "--seq-len", "16", "-b", "16"])
+
+
+def test_lm_cli_pipeline_bounds_guards(tmp_path, monkeypatch):
+    from distributed_model_parallel_tpu.cli import lm as lm_cli
+
+    monkeypatch.chdir(tmp_path)
+    with pytest.raises(SystemExit, match="must be >= 1"):
+        lm_cli.main(["--pipeline-stages", "4", "--microbatches", "0",
+                     "--seq-len", "16", "-b", "16"])
+    with pytest.raises(SystemExit, match="exceeds"):
+        lm_cli.main(["--pipeline-stages", "8", "--layers", "4",
+                     "--seq-len", "16", "-b", "16"])
