@@ -64,6 +64,29 @@ def compile_multi_step(engine: Any, k: int) -> Callable:
     return jax.jit(k_steps, donate_argnums=(0,))
 
 
+def compile_multi_eval(engine: Any, k: int) -> Callable:
+    """Eval twin of `compile_multi_step`: `fn(state, batches) ->
+    summed_metrics` evaluating k batches in one compiled program
+    (state is read-only — no carry, a plain scan over the stack)."""
+    if k < 2:
+        raise ValueError(f"steps_per_dispatch must be >= 2, got {k}")
+
+    def k_evals(state, batches: Tuple):
+        stacked = jax.tree_util.tree_map(
+            lambda *xs: jnp.stack(xs), *batches
+        )
+
+        def body(carry, batch):
+            return carry, engine.eval_step(state, *batch)
+
+        _, per_step = lax.scan(body, 0, stacked)
+        return jax.tree_util.tree_map(
+            lambda x: jnp.sum(x, axis=0), per_step
+        )
+
+    return jax.jit(k_evals)
+
+
 def group_batches(iterator, k: int):
     """Pull up to `k` items from `iterator`; a short list means the
     iterator was exhausted (the caller's per-step fallback path)."""
@@ -76,4 +99,4 @@ def group_batches(iterator, k: int):
     return group
 
 
-__all__ = ["compile_multi_step", "group_batches"]
+__all__ = ["compile_multi_eval", "compile_multi_step", "group_batches"]

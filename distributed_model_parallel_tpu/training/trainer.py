@@ -42,6 +42,7 @@ from distributed_model_parallel_tpu.training.checkpoint import (
     save_checkpoint,
 )
 from distributed_model_parallel_tpu.training.multistep import (
+    compile_multi_eval,
     compile_multi_step,
     group_batches,
 )
@@ -168,7 +169,8 @@ class Trainer:
                 )
         self.history: list[dict] = []
         self._profiled = False
-        self._multi = None  # lazily compiled k-step dispatch
+        self._multi = None       # lazily compiled k-step train dispatch
+        self._multi_eval = None  # lazily compiled k-batch eval dispatch
 
     # ------------------------------------------------------------- loops
 
@@ -311,22 +313,36 @@ class Trainer:
         sums = None
         n_batches = 0
         data_time = 0.0
+        k = max(1, self.config.steps_per_dispatch)
+        if hasattr(self.val_loader, "__len__"):
+            k = max(1, min(k, len(self.val_loader)))
         epoch_start = time.perf_counter()
         while True:
             t0 = time.perf_counter()
-            try:
-                images, labels = next(it)
-            except StopIteration:
-                break
+            host_batches = group_batches(it, k)
             data_time += time.perf_counter() - t0
-            images, labels = self.engine.shard_batch(images, labels)
-            metrics = self.engine.eval_step(self.state, images, labels)
+            if not host_batches:
+                break
+            placed = [self.engine.shard_batch(*b) for b in host_batches]
+            if len(placed) == k and k > 1:
+                if self._multi_eval is None:
+                    self._multi_eval = compile_multi_eval(self.engine, k)
+                metrics = self._multi_eval(self.state, tuple(placed))
+            else:
+                metrics = None
+                for b in placed:
+                    m_i = self.engine.eval_step(self.state, *b)
+                    metrics = (
+                        m_i
+                        if metrics is None
+                        else jax.tree_util.tree_map(jnp.add, metrics, m_i)
+                    )
             sums = (
                 metrics
                 if sums is None
                 else jax.tree_util.tree_map(jnp.add, sums, metrics)
             )
-            n_batches += 1
+            n_batches += len(placed)
         if sums is not None:
             sums = jax.device_get(sums)  # value-fetch barrier, as above
         wall = time.perf_counter() - epoch_start

@@ -99,9 +99,11 @@ def test_checkpoint_missing_raises(engine, tmp_path):
 
 def test_multi_step_dispatch_matches_per_step(tmp_path):
     """steps_per_dispatch folds k steps into one lax.scan program; the
-    trajectory (losses, accs, final params) must be IDENTICAL to per-step
-    dispatch — it is the same math, only the dispatch count changes.
-    7 batches with k=3 also exercises the short-tail fallback (3+3+1)."""
+    trajectory (losses, accs, final params) must match per-step dispatch
+    to numerical tolerance — same math, only the dispatch count changes.
+    7 batches with k=3 also exercises the short-tail fallback (3+3+1),
+    and the val-loss assertions pin the FUSED EVAL path
+    (compile_multi_eval drives validate() whenever k > 1)."""
     train, val = loaders(n=224, batch=32)  # 7 train batches/epoch
     mesh = make_mesh(MeshSpec(data=8))
     common = dict(
@@ -138,6 +140,38 @@ def test_multi_step_dispatch_matches_per_step(tmp_path):
         np.testing.assert_allclose(
             np.asarray(a), np.asarray(b), rtol=1e-5, atol=1e-6,
             err_msg=jax.tree_util.keystr(path),
+        )
+
+
+def test_compile_multi_eval_matches_per_batch(engine):
+    """Direct pin of the fused-eval program: summed metrics over k
+    stacked batches == accumulating k separate eval_step calls."""
+    import jax.numpy as jnp
+
+    from distributed_model_parallel_tpu.training.multistep import (
+        compile_multi_eval,
+    )
+
+    ds = synthetic(num_examples=96, num_classes=4, image_size=8, seed=3)
+    ts = engine.init_state(jax.random.PRNGKey(0))
+    batches = [
+        engine.shard_batch(ds.images[i * 32:(i + 1) * 32]
+                           .astype(np.float32) / 255.0,
+                           ds.labels[i * 32:(i + 1) * 32]
+                           .astype(np.int32))
+        for i in range(3)
+    ]
+    fused = compile_multi_eval(engine, 3)(ts, tuple(batches))
+    want = None
+    for b in batches:
+        m = engine.eval_step(ts, *b)
+        want = m if want is None else jax.tree_util.tree_map(
+            jnp.add, want, m
+        )
+    for key in want:
+        np.testing.assert_allclose(
+            float(fused[key]), float(want[key]), rtol=1e-6,
+            err_msg=key,
         )
 
 
