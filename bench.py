@@ -216,9 +216,31 @@ def run_child(model_name: str, batch: int, dtypes: list[str],
 
         force_cpu(8)
     log("initializing backend...")
-    import jax
+    # Hard timeout on the dial itself (the round-5 failure mode: a wedged
+    # TPU relay hangs jax.devices() forever, the parent's deadline kill
+    # erases the round's scoreboard). SIGALRM interrupts the socket wait
+    # and we emit a partial "backend: unreachable" line instead; a hang
+    # inside non-GIL-releasing plugin code still falls to the parent's
+    # process-group kill.
+    dial_timeout = int(os.environ.get("BENCH_DIAL_TIMEOUT_S", "180"))
 
-    devs = jax.devices()
+    def _dial_alarm(signum, frame):
+        raise TimeoutError(f"backend dial exceeded {dial_timeout}s")
+
+    prev_alarm = signal.signal(signal.SIGALRM, _dial_alarm)
+    if not cpu:
+        signal.alarm(dial_timeout)
+    try:
+        import jax
+
+        devs = jax.devices()
+    except TimeoutError as e:
+        emit(0.0, 0.0, platform="none", backend="unreachable",
+             model=model_name, batch=batch, error=str(e))
+        return
+    finally:
+        signal.alarm(0)
+        signal.signal(signal.SIGALRM, prev_alarm)
     platform = devs[0].platform
     device_kind = devs[0].device_kind
     n_chips = len(devs)
@@ -531,7 +553,15 @@ def main() -> None:
         line = _json_line(out)
         if line:
             parsed = json.loads(line)
-            if parsed.get("platform") != "cpu":
+            if parsed.get("backend") == "unreachable":
+                # The child's dial timeout fired: the relay is wedged.
+                # Not retry-eligible (the child already waited the full
+                # dial budget) — fall through to the CPU diagnostic,
+                # which preserves this line's diagnosis in its JSON.
+                accel_err = parsed.get("error", "backend unreachable")
+                log(f"accelerator unreachable: {accel_err}")
+                break
+            if parsed.get("platform") not in ("cpu", "none"):
                 # A valid accelerator line is a success regardless of how
                 # the child ENDED (rc 0, deadline kill, or a crash in the
                 # optional post-emit north-star extra) — the child emits
@@ -579,17 +609,20 @@ def main() -> None:
         if rc == 0 and line:
             parsed = json.loads(line)
             parsed["vs_baseline"] = 0.0
+            parsed["backend"] = "unreachable"
             parsed["error"] = (
                 "accelerator unavailable; tinycnn diagnostic on virtual-CPU "
                 f"mesh. accelerator error: {accel_err}"
             )
             print(json.dumps(parsed), flush=True)
             return
-        emit(0.0, 0.0, platform="cpu", model="tinycnn", batch=256,
+        emit(0.0, 0.0, platform="cpu", backend="unreachable",
+             model="tinycnn", batch=256,
              error=f"cpu fallback failed (rc={rc}): {(err or out)[-300:]}; "
                    f"accelerator error: {accel_err}")
     else:
-        emit(0.0, 0.0, platform="none", model="mobilenetv2", batch=512,
+        emit(0.0, 0.0, platform="none", backend="unreachable",
+             model="mobilenetv2", batch=512,
              error=f"budget exhausted; accelerator error: {accel_err}")
 
 

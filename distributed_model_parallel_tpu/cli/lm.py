@@ -78,7 +78,12 @@ def build_parser() -> argparse.ArgumentParser:
                         "(models/gpt.py split_stages + LMPipelineEngine);"
                         " mutually exclusive with --seq-shards > 1")
     p.add_argument("--microbatches", default=1, type=int,
-                   help="GPipe microbatches (pipeline mode)")
+                   help="pipeline microbatches (pipeline mode)")
+    p.add_argument("--pipeline-schedule", default="gpipe",
+                   choices=("gpipe", "1f1b"),
+                   help="pipeline schedule (pipeline mode): gpipe = "
+                        "fill-drain, O(M) live activations; 1f1b = "
+                        "PipeDream-flush, O(S) — same trajectory")
     p.add_argument("--attention", default="ring",
                    choices=("ring", "ring_flash", "ulysses",
                             "ulysses_flash"),
@@ -119,6 +124,11 @@ def main(argv=None) -> dict:
         raise SystemExit(
             "--microbatches is a pipeline-schedule knob; it has no "
             "effect without --pipeline-stages > 1"
+        )
+    if args.pipeline_stages <= 1 and args.pipeline_schedule != "gpipe":
+        raise SystemExit(
+            "--pipeline-schedule selects the pipeline engine's tick "
+            "program; it has no effect without --pipeline-stages > 1"
         )
     if args.microbatches < 1:
         raise SystemExit(
@@ -166,6 +176,7 @@ def main(argv=None) -> dict:
             num_microbatches=args.microbatches,
             compute_dtype=compute_dtype_from_flag(args.dtype),
             remat=args.remat,
+            schedule=args.pipeline_schedule,
             pad_token_id=cfg.pad_token_id,
         )
     else:

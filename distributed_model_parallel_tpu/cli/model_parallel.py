@@ -72,8 +72,16 @@ def build_parser() -> argparse.ArgumentParser:
     parser.add_argument("--momentum", default=0.9, type=float)
     # -- TPU-native additions --------------------------------------------
     parser.add_argument("--microbatches", default=1, type=int,
-                        help="GPipe microbatches in flight; 1 = the "
+                        help="pipeline microbatches in flight; 1 = the "
                              "reference's single-batch schedule")
+    parser.add_argument("--pipeline-schedule", default="gpipe",
+                        choices=("gpipe", "1f1b"),
+                        help="gpipe = fill-drain (O(M) live activations); "
+                             "1f1b = one-forward-one-backward "
+                             "(PipeDream-flush), same trajectory with "
+                             "O(S) live activations — lets "
+                             "--microbatches scale until the bubble is "
+                             "negligible")
     parser.add_argument("--reference-split", action="store_true",
                         help="use the reference's exact ws=4 stage "
                              "boundaries [3, 9, 15] (requires "
@@ -126,6 +134,7 @@ def main(argv=None) -> dict:
         compute_dtype=compute_dtype_from_flag(args.dtype),
         stage_local_params=args.stage_local_params,
         remat=args.remat,
+        schedule=args.pipeline_schedule,
     )
     cfg = TrainerConfig(
         epochs=args.epochs,

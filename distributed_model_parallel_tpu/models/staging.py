@@ -11,7 +11,9 @@ into the matching pipeline run and vice versa.
 
 from __future__ import annotations
 
-from typing import Any, List, Sequence
+from typing import Any, List, Sequence, Tuple
+
+import jax
 
 from distributed_model_parallel_tpu.models import layers as L
 
@@ -54,6 +56,29 @@ def assemble_stages(blocks: Sequence[L.Layer], stem: L.Layer, head: L.Layer,
             parts.append(head)
         stages.append(L.sequential(*parts))
     return stages
+
+
+def stage_io_avals(stages: Sequence[L.Layer], param_avals: Sequence[Any],
+                   state_avals: Sequence[Any], x_aval: Any,
+                   ctx: L.Context) -> List[Tuple[Any, Any]]:
+    """(input_aval, output_aval) per stage from an abstract trace — the
+    static replacement for the reference's runtime dim/size handshake
+    (`distributed_layers.py:40-47`), and the metadata every pipeline
+    schedule sizes its buffers from: the GPipe wire buffer is the max
+    output size, and the 1F1B activation ring holds per-stage *inputs*,
+    so ring sizing needs the input avals too (stage 0's input is the
+    image microbatch, which never rides the wire). Stage I/O may be any
+    pytree of arrays (e.g. BERT's (hidden, mask) pair)."""
+    avals = []
+    aval = x_aval
+    for i, stage in enumerate(stages):
+        out = jax.eval_shape(
+            lambda p, s, x, stage=stage: stage.apply(p, s, x, ctx)[0],
+            param_avals[i], state_avals[i], aval,
+        )
+        avals.append((aval, out))
+        aval = out
+    return avals
 
 
 def partition_tree(tree: Any, cuts: Sequence[int]) -> List[dict]:

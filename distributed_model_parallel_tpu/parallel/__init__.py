@@ -4,7 +4,9 @@ from distributed_model_parallel_tpu.parallel.data_parallel import (  # noqa: F40
     TrainState,
 )
 from distributed_model_parallel_tpu.parallel.pipeline import (  # noqa: F401
+    LMPipelineEngine,
     PipelineEngine,
+    build_1f1b_schedule,
 )
 from distributed_model_parallel_tpu.parallel.sequence_parallel import (  # noqa: F401
     CausalLMSequenceParallelEngine,

@@ -28,6 +28,14 @@ but one idle (`Readme.md:283-292`: MP is      `num_microbatches` M: scan over
                                               M=1 reproduces the reference's
                                               single-batch schedule exactly
 
+Two schedules (INTERNALS.md §3b): `schedule="gpipe"` (above — backward is
+autodiff through the tick scan, O(M) live activations per stage) and
+`schedule="1f1b"` (PipeDream-flush — a hand-scheduled forward+backward
+tick program from `build_1f1b_schedule`, per-stage activation stash
+bounded by a min(S, M)-deep ring, so M scales until the bubble is
+negligible at O(S) memory). Gradients/trajectories are identical
+(tests/test_pipeline_schedule.py).
+
 Combinable with data parallelism: a (data=D, stage=S) mesh runs D
 independent pipelines, gradients pmean over 'data' and psum over 'stage'
 in the same fused reduction.
@@ -54,16 +62,18 @@ from __future__ import annotations
 import dataclasses
 import math
 from functools import partial
-from typing import Any, List, Sequence, Tuple
+from typing import Any, List, NamedTuple, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
-from jax import shard_map
+from distributed_model_parallel_tpu.runtime.compat import shard_map
 
 from distributed_model_parallel_tpu.models.layers import Context, Layer
 from distributed_model_parallel_tpu.models.layers import remat as remat_layer
+from distributed_model_parallel_tpu.models.staging import stage_io_avals
 from distributed_model_parallel_tpu.parallel.data_parallel import (
     TrainState,
     _cast_input,
@@ -154,6 +164,168 @@ def _unpack(buf: jax.Array, aval_tree):
     return jax.tree_util.tree_unflatten(treedef, out)
 
 
+# ---------------------------------------------------------------------------
+# 1F1B (PipeDream-flush) tick schedule — built on the host at setup time.
+# ---------------------------------------------------------------------------
+
+# Per-(tick, stage) work kinds. IDLE ticks are pipeline bubble: the SPMD
+# program still executes a (masked) forward branch in lockstep.
+PIPE_IDLE, PIPE_FWD, PIPE_BWD = 0, 1, 2
+
+
+class Schedule1F1B(NamedTuple):
+    """Static tick tables for the 1F1B schedule, all shaped (T, S).
+
+    `work[t, s]` / `micro[t, s]` say what stage s computes at tick t;
+    `recv_fwd*` / `recv_bwd*` say whether the activation (up) / cotangent
+    (down) wire buffer a stage holds at the START of tick t carries a
+    valid payload, and for which microbatch — the receive side of the
+    schedule, derived from the sender side one tick earlier. Ring depths
+    are the peak number of simultaneously-live activations / cotangents
+    at any stage: the O(S) memory bound that is the point of 1F1B."""
+
+    work: np.ndarray
+    micro: np.ndarray
+    recv_fwd: np.ndarray
+    recv_fwd_m: np.ndarray
+    recv_bwd: np.ndarray
+    recv_bwd_m: np.ndarray
+    num_ticks: int
+    stash_depth: int
+    cot_depth: int
+
+
+def _min_ring_depth(intervals_per_slotkey: dict, max_key: int) -> int:
+    """Smallest ring depth R such that assigning key k to slot k % R never
+    overlaps two live intervals [start, end] (inclusive; arrival happens
+    BEFORE compute within a tick, so reuse must be strictly later)."""
+    for depth in range(1, max_key + 2):
+        ok = True
+        for (s, m), (start, _end) in intervals_per_slotkey.items():
+            prev = intervals_per_slotkey.get((s, m - depth))
+            if prev is not None and start <= prev[1]:
+                ok = False
+                break
+        if ok:
+            return depth
+    return max_key + 1
+
+
+def build_1f1b_schedule(num_stages: int, num_microbatches: int) -> Schedule1F1B:
+    """One-forward-one-backward (PipeDream-flush) tick program.
+
+    Stage s warms up with min(S-1-s, M) forwards, then alternates
+    (forward, backward) pairs, then drains the remaining backwards —
+    Megatron's non-interleaved 1F1B work order. Ticks are assigned by a
+    greedy lockstep simulation: at each tick a stage runs the head of its
+    work queue iff its dependencies completed at an EARLIER tick (one
+    ppermute hop separates producer and consumer), else it idles. The
+    program length never exceeds 2M + 2(S-1) — the same fill+drain span
+    as GPipe's forward+backward — while the number of microbatch
+    activations any stage holds live stays <= min(S, M), independent of M
+    (GPipe-through-autodiff holds all M)."""
+    S, M = num_stages, num_microbatches
+    if S < 1 or M < 1:
+        raise ValueError(f"need S >= 1, M >= 1; got S={S}, M={M}")
+    queues = []
+    for s in range(S):
+        warm = min(S - 1 - s, M)
+        q = [(PIPE_FWD, m) for m in range(warm)]
+        for i in range(M - warm):
+            q.append((PIPE_FWD, warm + i))
+            q.append((PIPE_BWD, i))
+        q.extend((PIPE_BWD, m) for m in range(M - warm, M))
+        queues.append(q)
+
+    done_f = [[None] * M for _ in range(S)]  # tick stage s finished fwd m
+    done_b = [[None] * M for _ in range(S)]
+    heads = [0] * S
+    work_rows, micro_rows = [], []
+    t = 0
+    while any(heads[s] < len(queues[s]) for s in range(S)):
+        if t > 2 * M + 2 * S:  # greedy 1F1B provably fits well inside this
+            raise RuntimeError(
+                f"1F1B schedule deadlocked at tick {t} (S={S}, M={M})"
+            )
+        row_w, row_m = [PIPE_IDLE] * S, [0] * S
+        for s in range(S):
+            if heads[s] >= len(queues[s]):
+                continue
+            kind, m = queues[s][heads[s]]
+            if kind == PIPE_FWD:
+                ready = s == 0 or (
+                    done_f[s - 1][m] is not None and done_f[s - 1][m] < t
+                )
+            else:
+                ready = done_f[s][m] is not None and done_f[s][m] < t
+                if s < S - 1:
+                    ready = ready and (
+                        done_b[s + 1][m] is not None and done_b[s + 1][m] < t
+                    )
+            if ready:
+                row_w[s], row_m[s] = kind, m
+        # Commit after scanning every stage: this tick's completions become
+        # visible only from t+1 (the `< t` checks above), matching the
+        # one-tick ppermute latency of the lockstep SPMD program.
+        for s in range(S):
+            if row_w[s] == PIPE_FWD:
+                done_f[s][row_m[s]] = t
+                heads[s] += 1
+            elif row_w[s] == PIPE_BWD:
+                done_b[s][row_m[s]] = t
+                heads[s] += 1
+        work_rows.append(row_w)
+        micro_rows.append(row_m)
+        t += 1
+
+    T = t
+    assert T <= 2 * M + 2 * (S - 1) or S == 1, (T, S, M)
+    work = np.asarray(work_rows, np.int32)
+    micro = np.asarray(micro_rows, np.int32)
+
+    # Receive tables: what the wire buffers hold at the START of tick t is
+    # whatever the neighbor put on them at tick t-1.
+    recv_fwd = np.zeros((T, S), bool)
+    recv_fwd_m = np.zeros((T, S), np.int32)
+    recv_bwd = np.zeros((T, S), bool)
+    recv_bwd_m = np.zeros((T, S), np.int32)
+    for tt in range(1, T):
+        for s in range(S):
+            if s >= 1 and work[tt - 1, s - 1] == PIPE_FWD:
+                recv_fwd[tt, s] = True
+                recv_fwd_m[tt, s] = micro[tt - 1, s - 1]
+            if s <= S - 2 and work[tt - 1, s + 1] == PIPE_BWD:
+                recv_bwd[tt, s] = True
+                recv_bwd_m[tt, s] = micro[tt - 1, s + 1]
+
+    # Ring depths from the exact live intervals (inclusive ticks):
+    # * activation stash at stage s>=1: arrival F(s-1,m)+1 .. consumption
+    #   by the backward B(s,m) (stage 0 reads the resident input batch
+    #   directly and never stashes);
+    # * cotangent at stage s<=S-2: arrival B(s+1,m)+1 .. B(s,m).
+    stash_iv = {
+        (s, m): (done_f[s - 1][m] + 1, done_b[s][m])
+        for s in range(1, S)
+        for m in range(M)
+    }
+    cot_iv = {
+        (s, m): (done_b[s + 1][m] + 1, done_b[s][m])
+        for s in range(S - 1)
+        for m in range(M)
+    }
+    stash_depth = _min_ring_depth(stash_iv, M - 1) if stash_iv else 1
+    cot_depth = _min_ring_depth(cot_iv, M - 1) if cot_iv else 1
+    if stash_depth > min(S, M):
+        raise RuntimeError(  # the O(S) guarantee this schedule exists for
+            f"1F1B stash depth {stash_depth} exceeds min(S, M)="
+            f"{min(S, M)} at S={S}, M={M}"
+        )
+    return Schedule1F1B(
+        work, micro, recv_fwd, recv_fwd_m, recv_bwd, recv_bwd_m,
+        T, stash_depth, cot_depth,
+    )
+
+
 @dataclasses.dataclass
 class PipelineEngine:
     """GPipe-style pipeline engine over the `'stage'` mesh axis.
@@ -183,9 +355,26 @@ class PipelineEngine:
     # False keeps the replicated representation (params as a per-stage
     # tuple of pytrees on every device).
     stage_local_params: bool = False
+    # Pipeline schedule:
+    # * "gpipe" — fill-drain: all M forwards, then all M backwards (the
+    #   backward derived by autodiff through the tick scan). Live
+    #   activation memory grows O(M) per stage: the memory the schedule
+    #   needs grows exactly as fast as raising M shrinks the bubble.
+    # * "1f1b"  — PipeDream-flush: warmup, then each stage alternates one
+    #   forward and one backward tick (hand-scheduled vjp per stage, same
+    #   2(M+S-1)-tick span). Live activations are capped by a
+    #   min(S, M)-deep ring buffer, independent of M — so microbatch
+    #   count can scale until the bubble is negligible. Gradients and BN
+    #   state match "gpipe" exactly (same per-microbatch math, same
+    #   fold order); only the schedule and its memory change.
+    schedule: str = "gpipe"
 
     def __post_init__(self):
         mesh = self.mesh
+        if self.schedule not in ("gpipe", "1f1b"):
+            raise ValueError(
+                f"schedule must be 'gpipe' or '1f1b', got {self.schedule!r}"
+            )
         if "stage" not in mesh.axis_names:
             raise ValueError("pipeline mesh needs a 'stage' axis")
         self.num_stages = mesh.shape["stage"]
@@ -228,6 +417,19 @@ class PipelineEngine:
             (_tree_size(a) for a in self._state_avals), default=1
         ) or 1
         self._stage_sh = NamedSharding(mesh, P(("stage",)))
+        if self.stage_local_params:
+            # Validate the optimizer's state_shardings declaration NOW:
+            # a field built from neither protocol argument would otherwise
+            # surface as an opaque trace/spec error inside the first
+            # checkpoint or step build (and legacy shard_map validates
+            # specs eagerly). Construction is where a protocol violation
+            # should be loud.
+            self._opt_param_fields()
+        # 1F1B tick tables are static in (S, M): build once, fail early.
+        self._sched_1f1b = (
+            build_1f1b_schedule(self.num_stages, self.num_microbatches)
+            if self.schedule == "1f1b" else None
+        )
 
         donate = (0,) if self.donate else ()
         self.train_step = jax.jit(
@@ -404,23 +606,13 @@ class PipelineEngine:
         return _place_batch((images, labels), self._batch)
 
     def _stage_avals(self, x_aval, train: bool):
-        """(input_avals, output_avals) per stage from an abstract trace —
-        the static replacement for the reference's runtime dim/size
-        handshake (`distributed_layers.py:40-47`). Stage I/O may be any
-        pytree of arrays (e.g. BERT's (hidden, mask) pair); everything
-        crosses stages packed into one flat buffer of the common wire
-        dtype."""
-        ctx = Context(train=train, dtype=self.compute_dtype)
-        aval = x_aval
-        avals = []
-        for i, stage in enumerate(self.stages):
-            out = jax.eval_shape(
-                lambda p, s, x, stage=stage: stage.apply(p, s, x, ctx)[0],
-                self._param_avals[i], self._state_avals[i], aval,
-            )
-            avals.append((aval, out))
-            aval = out
-        return avals
+        """(input_avals, output_avals) per stage — `staging.stage_io_avals`
+        on this engine's abstract params/state; everything crosses stages
+        packed into one flat buffer of the common wire dtype."""
+        return stage_io_avals(
+            self.stages, self._param_avals, self._state_avals, x_aval,
+            Context(train=train, dtype=self.compute_dtype),
+        )
 
     # ------------------------------------------------------- the program
 
@@ -448,10 +640,10 @@ class PipelineEngine:
             return _unpack(state[0], self._state_avals[i]) if local \
                 else state[i]
 
-        def pipeline_forward(params, model_state, images, labels, step):
-            """Runs on ONE device (inside shard_map): the full fill-drain
-            schedule for this device's stage. Returns (sum CE over local
-            batch, logits for the local batch, updated state)."""
+        def program_setup(images):
+            """Static per-trace metadata shared by both schedules: cast
+            input, microbatch split, the stage-I/O aval chain, the logits
+            contract of the last stage, and the wire buffer format."""
             images = _cast_input(images, cdt)
             n_local = images.shape[0]
             if n_local % M:
@@ -479,6 +671,15 @@ class PipelineEngine:
             rows, num_classes = out_leaves[0].shape
             buf_size = max(_tree_size(out) for _, out in avals)
             wire_dt = _wire_dtype(avals)
+            return images, mb, avals, rows, num_classes, buf_size, wire_dt
+
+        def pipeline_forward(params, model_state, images, labels, step):
+            """Runs on ONE device (inside shard_map): the full fill-drain
+            schedule for this device's stage. Returns (sum CE over local
+            batch, logits for the local batch, updated state)."""
+            images, mb, avals, rows, num_classes, buf_size, wire_dt = (
+                program_setup(images)
+            )
             s_idx = lax.axis_index("stage")
 
             def make_branch(i):
@@ -582,6 +783,245 @@ class PipelineEngine:
             )
             return loss_sum, (logits, new_state, is_last)
 
+        sched = self._sched_1f1b
+
+        def pipeline_1f1b(params, model_state, images, labels, step):
+            """Hand-scheduled 1F1B (PipeDream-flush) forward+backward on
+            ONE device. Unlike `pipeline_forward` (whose backward is
+            autodiff through the whole tick scan, saving every tick's
+            residuals — O(M) live activations), this runs the static
+            `build_1f1b_schedule` tick tables: forward ticks stash only
+            the stage's in-flight input window into a min(S, M)-deep ring
+            buffer; backward ticks re-run the stage under `jax.vjp` on
+            the stashed input (recompute is exact: BN normalizes with
+            batch statistics in train mode, and the (stage, microbatch)
+            dropout key is deterministic), seed it with the cotangent the
+            down-wire delivered (or the loss gradient on the last stage),
+            accumulate the parameter gradient in place, and send the
+            input-cotangent one hop upstream. Two wires run concurrently
+            — activations ppermute up, cotangents ppermute down — so the
+            backward schedule interleaves with the forward instead of
+            running as a full reversed drain.
+
+            Returns (loss_sum, logits, new_state, grads, is_last); grads
+            are the UNNORMALIZED sum over microbatches (the caller
+            divides by its loss normalizer — a linear pull-out of the
+            same scaling `jax.grad` applies under "gpipe")."""
+            images, mb, avals, rows, num_classes, buf_size, wire_dt = (
+                program_setup(images)
+            )
+            T, R, Rc = sched.num_ticks, sched.stash_depth, sched.cot_depth
+            # Trace-time record for the structural memory tests: the
+            # activation stash traced into this step is (R, buf_size).
+            self._last_1f1b_trace = {
+                "num_ticks": T, "stash_depth": R, "cot_depth": Rc,
+                "buf_size": buf_size,
+            }
+            work_tab = jnp.asarray(sched.work)
+            micro_tab = jnp.asarray(sched.micro)
+            recv_f = jnp.asarray(sched.recv_fwd)
+            recv_f_m = jnp.asarray(sched.recv_fwd_m)
+            recv_b = jnp.asarray(sched.recv_bwd)
+            recv_b_m = jnp.asarray(sched.recv_bwd_m)
+            s_idx = lax.axis_index("stage")
+            images_mbs = images.reshape((M, mb) + images.shape[1:])
+            labels_mbs = labels.reshape((M, -1))
+            rng_base = jax.random.fold_in(
+                jax.random.fold_in(jax.random.PRNGKey(0), step),
+                lax.axis_index("data"),
+            )
+
+            def make_branch(i):
+                in_aval, out_aval = avals[i]
+
+                def branch(operand):
+                    state, stash, cots, grads, m, w, rng = operand
+                    ctx = Context(
+                        train=True, bn_axis=bn_axis, rng=rng, dtype=cdt
+                    )
+                    p_i = stage_params(params, i)
+                    s_i = stage_state(state, i)
+                    # Stage 0's input batch is device-resident, so it is
+                    # never stashed: both work kinds index images_mbs.
+                    if i == 0:
+                        x = lax.dynamic_index_in_dim(images_mbs, m, 0, False)
+                    else:
+                        x = _unpack(
+                            lax.dynamic_index_in_dim(stash, m % R, 0, False),
+                            in_aval,
+                        )
+
+                    def fwd(_):
+                        y, new_si = exec_stages[i].apply(p_i, s_i, x, ctx)
+                        y_pad = _pack(y, buf_size, wire_dt)
+                        # Bubble (idle) ticks run this branch on garbage
+                        # in SPMD lockstep: mask state and output.
+                        valid = w == PIPE_FWD
+                        if local:
+                            packed = _pack(new_si, self._ssize)[None, :]
+                            new_state = jnp.where(valid, packed, state)
+                        else:
+                            masked = jax.tree_util.tree_map(
+                                lambda new, old: jnp.where(valid, new, old),
+                                new_si, state[i],
+                            )
+                            new_state = tuple(
+                                masked if j == i else state[j]
+                                for j in range(S)
+                            )
+                        y_pad = jnp.where(
+                            valid, y_pad, jnp.zeros_like(y_pad)
+                        )
+                        return (
+                            y_pad, jnp.zeros((buf_size,), wire_dt),
+                            new_state, grads,
+                        )
+
+                    def bwd(_):
+                        if i == S - 1:
+                            lbl = lax.dynamic_index_in_dim(
+                                labels_mbs, m, 0, False
+                            )
+
+                            def f(p, xx):
+                                y, _ = exec_stages[i].apply(p, s_i, xx, ctx)
+                                y_pad = _pack(y, buf_size, wire_dt)
+                                logits_mb = (
+                                    y_pad[: rows * num_classes]
+                                    .reshape(rows, num_classes)
+                                    .astype(jnp.float32)
+                                )
+                                return (
+                                    cross_entropy(logits_mb, lbl)
+                                    * valid_count(lbl)
+                                )
+
+                            _, vjp_fn = jax.vjp(f, p_i, x)
+                            gp, gx = vjp_fn(jnp.ones((), jnp.float32))
+                        else:
+
+                            def f(p, xx):
+                                y, _ = exec_stages[i].apply(p, s_i, xx, ctx)
+                                return _pack(y, buf_size, wire_dt)
+
+                            _, vjp_fn = jax.vjp(f, p_i, x)
+                            gp, gx = vjp_fn(
+                                lax.dynamic_index_in_dim(
+                                    cots, m % Rc, 0, False
+                                )
+                            )
+                        # Stage 0 has no upstream (and in LM mode an
+                        # integer input whose cotangent is symbolic-zero).
+                        down = (
+                            jnp.zeros((buf_size,), wire_dt) if i == 0
+                            else _pack(gx, buf_size, wire_dt)
+                        )
+                        if local:
+                            new_grads = (
+                                grads + _pack(gp, self._psize)[None, :]
+                            )
+                        else:
+                            g_i = jax.tree_util.tree_map(
+                                jnp.add, grads[i], gp
+                            )
+                            new_grads = tuple(
+                                g_i if j == i else grads[j]
+                                for j in range(S)
+                            )
+                        return (
+                            jnp.zeros((buf_size,), wire_dt), down, state,
+                            new_grads,
+                        )
+
+                    return lax.cond(w == PIPE_BWD, bwd, fwd, 0)
+
+                return branch
+
+            branches = [make_branch(i) for i in range(S)]
+            up_pairs = [(i, i + 1) for i in range(S - 1)]
+            down_pairs = [(i + 1, i) for i in range(S - 1)]
+
+            def tick(carry, t):
+                up_buf, down_buf, stash, cots, state, out_stack, grads = carry
+                w = work_tab[t, s_idx]
+                m = micro_tab[t, s_idx]
+                # Receive: the wire buffers hold tick t-1's permute
+                # output; the static tables say whether that payload is
+                # real and which microbatch's ring slot it belongs in
+                # (receive-before-compute, so a tick may consume the
+                # activation/cotangent that just arrived).
+                slot = recv_f_m[t, s_idx] % R
+                stash = lax.dynamic_update_index_in_dim(
+                    stash,
+                    jnp.where(
+                        recv_f[t, s_idx], up_buf,
+                        lax.dynamic_index_in_dim(stash, slot, 0, False),
+                    ),
+                    slot, 0,
+                )
+                cslot = recv_b_m[t, s_idx] % Rc
+                cots = lax.dynamic_update_index_in_dim(
+                    cots,
+                    jnp.where(
+                        recv_b[t, s_idx], down_buf,
+                        lax.dynamic_index_in_dim(cots, cslot, 0, False),
+                    ),
+                    cslot, 0,
+                )
+                # Per-(stage, microbatch) dropout key — identical at the
+                # forward tick and its backward-tick recompute.
+                rng = jax.random.fold_in(
+                    jax.random.fold_in(rng_base, s_idx), m
+                )
+                up_out, down_out, state, grads = lax.switch(
+                    s_idx, branches, (state, stash, cots, grads, m, w, rng)
+                )
+                write = (w == PIPE_FWD) & (s_idx == S - 1)
+                logits_mb = (
+                    up_out[: rows * num_classes]
+                    .reshape(rows, num_classes)
+                    .astype(jnp.float32)
+                )
+                out_stack = lax.dynamic_update_index_in_dim(
+                    out_stack,
+                    jnp.where(
+                        write, logits_mb,
+                        lax.dynamic_index_in_dim(out_stack, m, 0, False),
+                    ),
+                    m, 0,
+                )
+                if S > 1:
+                    up_buf = lax.ppermute(up_out, "stage", up_pairs)
+                    down_buf = lax.ppermute(down_out, "stage", down_pairs)
+                else:
+                    up_buf, down_buf = up_out, down_out
+                return (
+                    up_buf, down_buf, stash, cots, state, out_stack, grads
+                ), None
+
+            if local:
+                grads0 = jnp.zeros((1, self._psize), jnp.float32)
+            else:
+                grads0 = jax.tree_util.tree_map(jnp.zeros_like, params)
+            carry0 = (
+                jnp.zeros((buf_size,), wire_dt),
+                jnp.zeros((buf_size,), wire_dt),
+                jnp.zeros((R, buf_size), wire_dt),   # activation ring
+                jnp.zeros((Rc, buf_size), wire_dt),  # cotangent ring
+                model_state,
+                jnp.zeros((M, rows, num_classes), jnp.float32),
+                grads0,
+            )
+            (_, _, _, _, new_state, out_stack, grads), _ = lax.scan(
+                tick, carry0, jnp.arange(T)
+            )
+            logits = out_stack.reshape(M * rows, num_classes)
+            is_last = (s_idx == S - 1).astype(logits.dtype)
+            loss_sum = (
+                cross_entropy(logits, labels) * valid_count(labels) * is_last
+            )
+            return loss_sum, logits, new_state, grads, is_last
+
         def reassemble_state(new_state, s_idx):
             """Each device updated only its own stage's BN state; rebuild
             the replicated tuple by masked psum over 'stage'."""
@@ -645,15 +1085,32 @@ class PipelineEngine:
                 # discipline.
                 loss_norm = jnp.maximum(valid_count(labels), 1.0)
 
-                def loss_fn(params):
-                    loss_sum, aux = pipeline_forward(
-                        params, ts.model_state, images, labels, ts.step
+                if self.schedule == "1f1b":
+                    # Hand-scheduled fwd+bwd: grads come back as the
+                    # unnormalized microbatch sum; dividing by loss_norm
+                    # is the same linear scaling jax.grad applies to the
+                    # gpipe loss below.
+                    loss_sum, logits, new_state, grads, is_last = (
+                        pipeline_1f1b(
+                            ts.params, ts.model_state, images, labels,
+                            ts.step,
+                        )
                     )
-                    return loss_sum / loss_norm, aux
+                    grads = jax.tree_util.tree_map(
+                        lambda g: g / loss_norm, grads
+                    )
+                    loss = loss_sum / loss_norm
+                else:
 
-                (loss, (logits, new_state, is_last)), grads = (
-                    jax.value_and_grad(loss_fn, has_aux=True)(ts.params)
-                )
+                    def loss_fn(params):
+                        loss_sum, aux = pipeline_forward(
+                            params, ts.model_state, images, labels, ts.step
+                        )
+                        return loss_sum / loss_norm, aux
+
+                    (loss, (logits, new_state, is_last)), grads = (
+                        jax.value_and_grad(loss_fn, has_aux=True)(ts.params)
+                    )
                 if local:
                     # Each device's flat grad IS its stage's full gradient
                     # (cotangents crossed stages through the reversed

@@ -33,7 +33,9 @@ from typing import Any
 
 import jax
 import jax.numpy as jnp
-from jax import lax, shard_map
+from jax import lax
+
+from distributed_model_parallel_tpu.runtime.compat import shard_map
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from distributed_model_parallel_tpu.models import layers as L

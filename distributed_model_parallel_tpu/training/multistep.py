@@ -20,7 +20,10 @@ the previous group's compute.
 Works with any engine exposing the uniform protocol
 `train_step(state, x, y, lr) -> (state, metrics)`: the engine's own
 jitted step (jit- or shard_map-built) is traced inline into the scan
-body, keeping its sharding annotations as constraints.
+body, keeping its sharding annotations as constraints. That includes
+steps that are themselves scans — PipelineEngine's tick programs (both
+the gpipe fill-drain and the hand-scheduled 1f1b forward+backward) nest
+as inner scans, pinned by tests/test_pipeline_schedule.py.
 """
 
 from __future__ import annotations

@@ -229,19 +229,27 @@ class Trainer:
                 profile_at = (
                     ga if (n_avail is None or ga < n_avail) else 0
                 )
-        epoch_start = time.perf_counter()
-        while True:
+        def fetch_group(n_done: int):
+            """Pull + device-place the next dispatch group (up to k host
+            batches, bounded by the steps_per_epoch budget; [] when the
+            epoch is exhausted). Host loading is what data_time measures;
+            shard_batch transfers are enqueued asynchronously, so calling
+            this right after a dispatch stages the NEXT group's arrays
+            while the current device step is still in flight."""
+            nonlocal data_time
             want = k
             if cfg.steps_per_epoch:
-                want = min(k, cfg.steps_per_epoch - n_batches)
+                want = min(k, cfg.steps_per_epoch - n_done)
                 if want <= 0:
-                    break
+                    return []
             t0 = time.perf_counter()
             host_batches = group_batches(it, want)
             data_time += time.perf_counter() - t0
-            if not host_batches:
-                break
-            placed = [self.engine.shard_batch(*b) for b in host_batches]
+            return [self.engine.shard_batch(*b) for b in host_batches]
+
+        epoch_start = time.perf_counter()
+        placed = fetch_group(0)
+        while placed:
             if (
                 profile_at is not None
                 and not profiling
@@ -275,6 +283,15 @@ class Trainer:
                     )
             prev = n_batches
             n_batches += len(placed)
+            # One-deep device prefetch: the dispatch above returned at
+            # enqueue time, so the next group's host load + placement
+            # overlaps the in-flight compute — and, crucially, runs
+            # BEFORE the progress print's device_get below fences on
+            # that compute. On the CPU test harness the effect is small
+            # (RESULTS.md §1g); the reorder exists for relay-attached
+            # accelerators, where the fence is a network round-trip and
+            # anything sequenced after it is dead time.
+            placed = fetch_group(n_batches)
             if profiling and n_batches >= profile_at + 3:
                 jax.block_until_ready(self.state)
                 jax.profiler.stop_trace()
@@ -291,7 +308,8 @@ class Trainer:
             ):
                 m = jax.device_get(metrics)  # fences this dispatch
                 self._log_print(
-                    f"Epoch: [{epoch}][{n_batches}/{len(self.train_loader)}]"
+                    f"Epoch: [{epoch}]"
+                    f"[{n_batches}/{n_avail if n_avail is not None else '?'}]"
                     f"\tLoss {m['loss_sum'] / m['count']:.4e}"
                     f"\tAcc@1 {100.0 * m['correct1'] / m['count']:.3f}"
                     f"\tTime {(time.perf_counter() - epoch_start) / n_batches:.3f}"

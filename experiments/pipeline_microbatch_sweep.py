@@ -1,13 +1,17 @@
-"""Pipeline microbatch sweep: measured time/batch vs the bubble math.
+"""Pipeline microbatch sweep: measured time/batch vs the bubble math,
+for BOTH schedules (GPipe fill-drain and 1F1B/PipeDream-flush).
 
 The reference's headline pipeline finding is that one-batch-in-flight
 model parallelism is ~4x slower than data parallelism
 (`/root/reference/Readme.md:283-292`) — a pure schedule artifact: with S
 stages and M microbatches the pipeline runs M+S-1 ticks for M microbatches
 of work, so time/batch scales like (M+S-1)/M (=S at the reference's M=1,
-->1 as M grows). This sweep measures that curve on the 4-stage engine and
-overlays the ideal, producing the schedule-analysis figure the
-reference's report format calls for (pic/).
+->1 as M grows). Both schedules share that bubble curve; what separates
+them is MEMORY. GPipe holds all M microbatch activations live through the
+backward (the stash grows O(M), so the bubble can only be shrunk by
+spending memory), while 1F1B caps the live window at min(S, M) — the
+sweep records each engine's traced stash metadata next to its throughput
+so the figure shows the schedule trade directly.
 
 Run: python experiments/pipeline_microbatch_sweep.py
 """
@@ -48,32 +52,45 @@ def main() -> None:
     images = rng.rand(batch, 8, 8, 3).astype(np.float32)
     labels = rng.randint(0, 10, size=(batch,)).astype(np.int32)
 
-    rows = []
+    schedules = ("gpipe", "1f1b")
+    rows = {sched: [] for sched in schedules}
     for m in (1, 2, 4, 8, 16):
-        engine = PipelineEngine(
-            stages, SGD(), mesh, num_microbatches=m, donate=False
-        )
-        ts = engine.init_state(jax.random.PRNGKey(0))
-        im, lb = engine.shard_batch(images, labels)
-        lr = jnp.float32(0.05)
-        for _ in range(2):  # compile + warm
-            ts, _ = engine.train_step(ts, im, lb, lr)
-        jax.block_until_ready(ts)
-        iters = 4
-        t0 = time.perf_counter()
-        for _ in range(iters):
-            ts, _ = engine.train_step(ts, im, lb, lr)
-        jax.block_until_ready(ts)
-        dt = (time.perf_counter() - t0) / iters
-        rows.append({"M": m, "time_per_batch": dt})
-        print(f"M={m:>2}: {dt:.3f} s/batch", flush=True)
+        for sched in schedules:
+            engine = PipelineEngine(
+                stages, SGD(), mesh, num_microbatches=m, donate=False,
+                schedule=sched,
+            )
+            ts = engine.init_state(jax.random.PRNGKey(0))
+            im, lb = engine.shard_batch(images, labels)
+            lr = jnp.float32(0.05)
+            for _ in range(2):  # compile + warm
+                ts, _ = engine.train_step(ts, im, lb, lr)
+            jax.block_until_ready(ts)
+            iters = 4
+            t0 = time.perf_counter()
+            for _ in range(iters):
+                ts, _ = engine.train_step(ts, im, lb, lr)
+            jax.block_until_ready(ts)
+            dt = (time.perf_counter() - t0) / iters
+            # Live activation window per stage: GPipe's autodiff stash is
+            # every in-flight microbatch; 1F1B's is its static ring.
+            if sched == "1f1b":
+                stash = engine._sched_1f1b.stash_depth
+            else:
+                stash = m
+            rows[sched].append(
+                {"M": m, "time_per_batch": dt, "live_activations": stash}
+            )
+            print(f"{sched:>5} M={m:>2}: {dt:.3f} s/batch, "
+                  f"live acts/stage={stash}", flush=True)
 
-    base = rows[0]["time_per_batch"]  # M=1: the reference's schedule
-    for r in rows:
-        m = r["M"]
-        r["speedup_vs_m1"] = round(base / r["time_per_batch"], 2)
-        # ideal time ratio t(M)/t(1) = (M+S-1) / (M*S)
-        r["ideal_speedup"] = round(m * S / (m + S - 1), 2)
+    for sched in schedules:
+        base = rows[sched][0]["time_per_batch"]  # M=1: reference schedule
+        for r in rows[sched]:
+            m = r["M"]
+            r["speedup_vs_m1"] = round(base / r["time_per_batch"], 2)
+            # ideal time ratio t(M)/t(1) = (M+S-1) / (M*S)
+            r["ideal_speedup"] = round(m * S / (m + S - 1), 2)
 
     os.makedirs("pic", exist_ok=True)
     with open("experiments/pipeline_microbatch_sweep.json", "w") as f:
@@ -84,20 +101,34 @@ def main() -> None:
     matplotlib.use("Agg")
     import matplotlib.pyplot as plt
 
-    ms = [r["M"] for r in rows]
-    fig, ax = plt.subplots(figsize=(6, 4))
-    ax.plot(ms, [r["speedup_vs_m1"] for r in rows], marker="o",
-            label="measured")
-    ax.plot(ms, [r["ideal_speedup"] for r in rows], marker="s",
+    ms = [r["M"] for r in rows["gpipe"]]
+    fig, (ax, ax2) = plt.subplots(1, 2, figsize=(11, 4))
+    ax.plot(ms, [r["speedup_vs_m1"] for r in rows["gpipe"]], marker="o",
+            label="gpipe measured")
+    ax.plot(ms, [r["speedup_vs_m1"] for r in rows["1f1b"]], marker="^",
+            label="1f1b measured")
+    ax.plot(ms, [r["ideal_speedup"] for r in rows["gpipe"]], marker="s",
             linestyle="--", label="ideal  M·S/(M+S−1)")
     ax.set_xscale("log", base=2)
     ax.set_xticks(ms)
     ax.set_xticklabels(ms)
     ax.set_xlabel("microbatches M")
     ax.set_ylabel("speedup vs M=1 (reference schedule)")
-    ax.set_title(f"GPipe fill-drain: bubble (S−1)/(M+S−1), S={S}")
+    ax.set_title(f"bubble (S−1)/(M+S−1), S={S}: both schedules")
     ax.grid(alpha=0.3)
     ax.legend()
+    ax2.plot(ms, [r["live_activations"] for r in rows["gpipe"]],
+             marker="o", label="gpipe  (O(M))")
+    ax2.plot(ms, [r["live_activations"] for r in rows["1f1b"]],
+             marker="^", label="1f1b  (O(S): ring ≤ min(S, M))")
+    ax2.set_xscale("log", base=2)
+    ax2.set_xticks(ms)
+    ax2.set_xticklabels(ms)
+    ax2.set_xlabel("microbatches M")
+    ax2.set_ylabel("live activations per stage")
+    ax2.set_title("activation memory vs M")
+    ax2.grid(alpha=0.3)
+    ax2.legend()
     fig.tight_layout()
     fig.savefig("pic/pipeline_microbatch_sweep.png", dpi=120)
     print("wrote pic/pipeline_microbatch_sweep.png")

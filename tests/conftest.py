@@ -19,11 +19,42 @@ if "xla_force_host_platform_device_count" not in flags:
         flags + " --xla_force_host_platform_device_count=8"
     ).strip()
 
+import re  # noqa: E402
+
 import jax  # noqa: E402
 import pytest  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
 jax.config.update("jax_default_matmul_precision", "highest")
+
+
+# Tier-1 budget guard: experiment sweeps (experiments/) time whole training
+# schedules and must only ever run under the `slow` marker. A test module
+# that imports experiments/ without marking every one of its tests slow
+# would silently blow the 870 s tier-1 window, so collection fails loudly.
+_EXPERIMENTS_IMPORT = re.compile(
+    r"^\s*(?:from|import)\s+experiments\b", re.MULTILINE
+)
+
+
+def pytest_collection_modifyitems(config, items):
+    offenders = []
+    checked = {}
+    for item in items:
+        path = str(getattr(item, "fspath", ""))
+        if path not in checked:
+            try:
+                with open(path) as f:
+                    checked[path] = bool(_EXPERIMENTS_IMPORT.search(f.read()))
+            except OSError:
+                checked[path] = False
+        if checked[path] and item.get_closest_marker("slow") is None:
+            offenders.append(item.nodeid)
+    if offenders:
+        raise pytest.UsageError(
+            "tests importing experiments/ must be marked @pytest.mark.slow "
+            "(tier-1 budget): " + ", ".join(sorted(offenders))
+        )
 
 
 @pytest.fixture(scope="session")

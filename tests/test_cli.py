@@ -68,6 +68,45 @@ def test_model_parallel_cli(tmp_path, monkeypatch):
     assert os.path.isfile(tmp_path / "log" / "64.txt")
 
 
+def test_model_parallel_cli_1f1b(tmp_path, monkeypatch):
+    """--pipeline-schedule 1f1b drives the full entry point; default
+    stays gpipe (no behavior change for existing launch lines)."""
+    monkeypatch.chdir(tmp_path)
+    result = model_parallel.main([
+        "./data",
+        "-type", "Synthetic",
+        "--world-size", "4",
+        "--model", "tinycnn",
+        "--microbatches", "2",
+        "--pipeline-schedule", "1f1b",
+        "-b", "64",
+        "--epochs", "1",
+        "--steps-per-epoch", "2",
+        "--lr", "0.1",
+    ])
+    assert len(result["history"]) == 1
+
+
+def test_pipeline_schedule_flag_defaults():
+    """Both pipeline-capable CLIs expose --pipeline-schedule, defaulting
+    to gpipe; lm.py rejects the flag without pipeline stages (it would
+    silently do nothing)."""
+    from distributed_model_parallel_tpu.cli import lm
+
+    args = model_parallel.build_parser().parse_args(
+        ["./data", "--world-size", "4"]
+    )
+    assert args.pipeline_schedule == "gpipe"
+    args = lm.build_parser().parse_args([])
+    assert args.pipeline_schedule == "gpipe"
+    args = lm.build_parser().parse_args(
+        ["--pipeline-stages", "2", "--pipeline-schedule", "1f1b"]
+    )
+    assert args.pipeline_schedule == "1f1b"
+    with pytest.raises(SystemExit):
+        lm.main(["--pipeline-schedule", "1f1b"])  # no --pipeline-stages
+
+
 def test_reference_split_builds_stages():
     """The ws=4 reference boundaries produce 4 composable stages
     (structural check; the compiled path runs in test_pipeline.py)."""

@@ -93,7 +93,10 @@ def test_data_parallel_lm_training_learns():
         return params2, loss
 
     losses = []
-    for _ in range(25):
+    # 40 plain-SGD steps: enough to halve the loss across JAX versions
+    # (convergence speed drifts slightly with backend numerics; 25 steps
+    # landed at 0.54x on jax 0.4.37's CPU backend).
+    for _ in range(40):
         params, loss = step(params, ids)
         losses.append(float(loss))
     assert losses[-1] < losses[0] * 0.5, losses[::6]
@@ -121,7 +124,7 @@ def test_attention_fn_swaps_match_dense(kind):
         )
         got, _ = model.apply(params, state, ids, L.Context(train=False))
     else:
-        from jax import shard_map
+        from distributed_model_parallel_tpu.runtime.compat import shard_map
         from distributed_model_parallel_tpu.models.gpt import (
             _lm_stem,
             decoder_blocks,

@@ -510,9 +510,12 @@ def test_opt_field_classification_uses_declaration(pp_mesh):
         def state_shardings(self, param_shardings, replicated):
             return TrapState(param_shardings, "weird")
 
-    bad = PipelineEngine(
-        tiny_stages(), BadDecl(), pp_mesh, num_microbatches=2,
-        donate=False, stage_local_params=True,
-    )
+    # A declaration built from neither protocol argument is rejected at
+    # engine CONSTRUCTION (the probe runs in __post_init__ so the error
+    # is loud and early, not an opaque spec failure inside the first
+    # step build or checkpoint).
     with pytest.raises(ValueError, match="state_shardings"):
-        bad._opt_param_fields()
+        PipelineEngine(
+            tiny_stages(), BadDecl(), pp_mesh, num_microbatches=2,
+            donate=False, stage_local_params=True,
+        )

@@ -11,7 +11,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 import pytest
-from jax import shard_map
+from distributed_model_parallel_tpu.runtime.compat import shard_map
 from jax.sharding import PartitionSpec as P
 
 from distributed_model_parallel_tpu.models import layers as L

@@ -249,6 +249,59 @@ def test_device_normalize_trainer_matches_host_normalize(tmp_path):
     )
 
 
+def test_generator_loader_without_len(engine, capsys):
+    """Regression: `train_epoch` must drive a plain generator loader (no
+    `__len__`, no `set_epoch`) end-to-end, including the progress print —
+    which once called `len(self.train_loader)` unconditionally and
+    crashed on exactly this loader shape. The unknown total renders as
+    '?'."""
+    ds = synthetic(num_examples=128, num_classes=4, image_size=8, seed=0)
+
+    def gen_loader():
+        for i in range(4):
+            lo = i * 32
+            yield (
+                ds.images[lo:lo + 32].astype(np.float32) / 255.0,
+                ds.labels[lo:lo + 32].astype(np.int32),
+            )
+
+    cfg = TrainerConfig(
+        epochs=1, base_lr=0.1, t_max=1, warmup_period=1, print_freq=2,
+        save_best=False,
+    )
+    trainer = Trainer(engine, gen_loader(), None, cfg,
+                      rng=jax.random.PRNGKey(0))
+    stats = trainer.train_epoch(0)
+    assert stats.count == 128
+    out = capsys.readouterr().out
+    assert "/?]" in out  # progress line printed with unknown total
+
+
+def test_generator_loader_with_fused_dispatch(engine):
+    """The same generator loader under steps_per_dispatch > 1: grouping
+    pulls from a bare iterator, the short tail (4 batches, k=3) falls
+    back to per-step dispatch, and the one-deep prefetch never double
+    consumes."""
+    ds = synthetic(num_examples=128, num_classes=4, image_size=8, seed=0)
+
+    def gen_loader():
+        for i in range(4):
+            lo = i * 32
+            yield (
+                ds.images[lo:lo + 32].astype(np.float32) / 255.0,
+                ds.labels[lo:lo + 32].astype(np.int32),
+            )
+
+    cfg = TrainerConfig(
+        epochs=1, base_lr=0.1, t_max=1, warmup_period=1, print_freq=0,
+        save_best=False, steps_per_dispatch=3,
+    )
+    trainer = Trainer(engine, gen_loader(), None, cfg,
+                      rng=jax.random.PRNGKey(0))
+    stats = trainer.train_epoch(0)
+    assert stats.count == 128
+
+
 def test_resume_continues_from_epoch(engine, tmp_path):
     train, val = loaders(n=128)
     common = dict(
