@@ -257,13 +257,6 @@ def run_child(model_name: str, batch: int, dtypes: list[str],
                    "accelerator-size measurement")
         return
 
-    results = {}
-    for dtype_name in dtypes:
-        results[dtype_name] = _measure(
-            model_name, batch, dtype_name, warmup=5, iters=30
-        )
-        log(f"{dtype_name}: {results[dtype_name]['img_per_sec']:.1f} img/s")
-
     peak = peak_bf16_flops(device_kind)
 
     def mfu_of(r):
@@ -274,28 +267,41 @@ def run_child(model_name: str, batch: int, dtypes: list[str],
             )
         return None
 
+    # Per-leg partial emission (VERDICT r5 ask): re-emit the headline
+    # line after EVERY completed dtype leg, so a deadline kill (or a
+    # relay that wedges) mid-sweep can no longer erase the legs that
+    # already ran — the parent drains our stdout and rescues the last
+    # line. Non-final legs carry "partial": true.
+    results = {}
+    extra = {}
     head_dtype = dtypes[0]
-    head = results[head_dtype]
-    extra = {
-        "platform": platform,
-        "device_kind": device_kind,
-        "n_chips": n_chips,
-        "model": model_name,
-        "batch": batch,
-        "dtype": head_dtype,
-        "sec_per_step": round(head["sec_per_step"], 4),
-        "mfu": mfu_of(head),
-        "flops_per_step": head["flops_per_step"],
-    }
-    for other in dtypes[1:]:
-        extra[f"{other}_img_per_sec"] = round(
-            results[other]["img_per_sec"], 1
+    for idx, dtype_name in enumerate(dtypes):
+        results[dtype_name] = _measure(
+            model_name, batch, dtype_name, warmup=5, iters=30
         )
-    # Emit the headline line NOW — if the parent's deadline kills us
-    # during the optional north-star measurement below, this line is
-    # already on stdout and the parent rescues it from the drain.
-    emit(head["img_per_sec"], head["img_per_sec"] / BASELINE_IMG_PER_SEC,
-         **extra)
+        log(f"{dtype_name}: {results[dtype_name]['img_per_sec']:.1f} img/s")
+        head = results[head_dtype]
+        extra = {
+            "platform": platform,
+            "device_kind": device_kind,
+            "n_chips": n_chips,
+            "model": model_name,
+            "batch": batch,
+            "dtype": head_dtype,
+            "sec_per_step": round(head["sec_per_step"], 4),
+            "mfu": mfu_of(head),
+            "flops_per_step": head["flops_per_step"],
+        }
+        for other in dtypes[1:idx + 1]:
+            extra[f"{other}_img_per_sec"] = round(
+                results[other]["img_per_sec"], 1
+            )
+        if idx < len(dtypes) - 1:
+            extra["partial"] = True
+        emit(head["img_per_sec"],
+             head["img_per_sec"] / BASELINE_IMG_PER_SEC, **extra)
+    # (the final loop iteration left `head`/`extra` at their complete,
+    # non-partial values — the north-star extras below extend them)
 
     if platform != "cpu" and model_name == "mobilenetv2":
         # North-star secondary metric (BASELINE.json): ResNet-50
@@ -441,6 +447,10 @@ def run_child_scaling(max_devices: int, model_name: str = "tinycnn",
         dt = time.perf_counter() - t0
         per_chip = batch * iters / dt / n
         rows.append({"chips": n, "img_per_sec_per_chip": round(per_chip, 1)})
+        # Per-leg partial line (VERDICT r5 ask): a relay wedge mid-sweep
+        # keeps the sizes that already measured — the parent drains
+        # stdout and folds these into its diagnostic JSON.
+        print(json.dumps({"leg": rows[-1], "partial": True}), flush=True)
     base = rows[0]["img_per_sec_per_chip"]
     for r in rows:
         r["weak_scaling_efficiency"] = round(
@@ -453,6 +463,141 @@ def run_child_scaling(max_devices: int, model_name: str = "tinycnn",
             "throughput necessarily drops ~1/N here; the harness is "
             "meaningful on real chips, where each mesh slot has its own "
             "silicon"
+        )
+    print(json.dumps(out, indent=2))
+
+
+def run_child_cm(max_devices: int, platform: str = "cpu") -> None:
+    """Naive-vs-overlapped collective-matmul microbench — the pjit
+    microbenchmark TODO from SNIPPETS [2], pointed at the latency-hiding
+    rings (`ops/collective_matmul.py`).
+
+    For each 'model' ring size S the device count hosts, times the
+    column->row projection pair (the per-transformer-block ag_matmul +
+    matmul_rs sites) in BOTH lowerings: monolithic (one all-gather /
+    one psum-scatter, overlap left to the scheduler) and chunked (S-1
+    ppermutes, each hop overlapping the chunk dot), forward and
+    forward+grad. Emits one partial JSON line per completed leg (axis
+    size) — a wedge mid-sweep keeps the finished legs — then the table.
+    Meaningful on a real slice; on virtual CPU devices the ring serializes
+    onto one core (the note in the JSON says so)."""
+    if max_devices < 2:
+        raise ValueError(f"--max-devices must be >= 2, got {max_devices}")
+    if platform == "cpu":
+        from distributed_model_parallel_tpu.runtime.platform import force_cpu
+
+        force_cpu(max_devices)
+
+    from functools import partial
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.sharding import Mesh, PartitionSpec as P
+
+    from distributed_model_parallel_tpu.ops.collective_matmul import (
+        ag_matmul,
+        matmul_rs,
+        naive_ag_matmul,
+        naive_matmul_rs,
+    )
+    from distributed_model_parallel_tpu.runtime.compat import shard_map
+
+    devices = jax.devices("cpu") if platform == "cpu" else jax.devices()
+    sizes = []
+    n = 2
+    while n <= min(max_devices, len(devices)):
+        sizes.append(n)
+        n *= 2
+
+    # Per-block projection pair at a transformer-ish aspect ratio; T
+    # scales with S (fixed per-device chunk) like real seq sharding.
+    batch, dmodel, dff = 4, 256, 1024
+    rng = np.random.RandomState(0)
+    w1 = jnp.asarray(0.02 * rng.randn(dmodel, dff), jnp.float32)
+    w2 = jnp.asarray(0.02 * rng.randn(dff, dmodel), jnp.float32)
+
+    def pair(col_fn, row_fn):
+        def f(x, w1, w2):
+            h = jax.nn.gelu(col_fn(x, w1, "model"), approximate=False)
+            return row_fn(h, w2, "model")
+        return f
+
+    def time_fn(fn, args, iters=20):
+        out = fn(*args)  # compile + warmup
+        _ = jax.device_get(out.ravel()[0])
+        t0 = time.perf_counter()
+        for _i in range(iters):
+            out = fn(*args)
+        _ = jax.device_get(out.ravel()[0])  # real completion barrier
+        return (time.perf_counter() - t0) / iters * 1e3
+
+    rows = []
+    for size in sizes:
+        mesh = Mesh(np.array(devices[:size]), ("model",))
+        x = jnp.asarray(
+            0.1 * rng.randn(batch, 32 * size, dmodel), jnp.float32
+        )
+        specs = dict(
+            mesh=mesh,
+            in_specs=(P(None, "model", None), P(None, "model"),
+                      P("model", None)),
+            check_vma=False,
+        )
+        ring = jax.jit(shard_map(
+            pair(ag_matmul, matmul_rs),
+            out_specs=P(None, "model", None), **specs,
+        ))
+        mono = jax.jit(shard_map(
+            pair(naive_ag_matmul, naive_matmul_rs),
+            out_specs=P(None, "model", None), **specs,
+        ))
+
+        def gradded(f):
+            def g(x, w1, w2):
+                def loss(x, w1, w2):
+                    y = f(x, w1, w2)
+                    return jnp.sum(y * y)
+                return jax.grad(loss, argnums=(0, 1, 2))(x, w1, w2)[0]
+            return jax.jit(g)
+
+        row = {
+            "axis_size": size,
+            "fwd_naive_ms": round(time_fn(mono, (x, w1, w2)), 3),
+            "fwd_overlapped_ms": round(time_fn(ring, (x, w1, w2)), 3),
+            "step_naive_ms": round(
+                time_fn(gradded(mono), (x, w1, w2)), 3
+            ),
+            "step_overlapped_ms": round(
+                time_fn(gradded(ring), (x, w1, w2)), 3
+            ),
+        }
+        row["fwd_speedup"] = round(
+            row["fwd_naive_ms"] / max(row["fwd_overlapped_ms"], 1e-9), 3
+        )
+        row["step_speedup"] = round(
+            row["step_naive_ms"] / max(row["step_overlapped_ms"], 1e-9), 3
+        )
+        rows.append(row)
+        log(f"S={size}: fwd {row['fwd_naive_ms']}ms naive vs "
+            f"{row['fwd_overlapped_ms']}ms overlapped")
+        # Per-leg partial line (same convention as the scaling sweep):
+        # a wedge mid-sweep keeps the finished axis sizes.
+        print(json.dumps({"leg": row, "partial": True}), flush=True)
+
+    out = {
+        "collective_matmul_microbench": rows,
+        "platform": jax.devices()[0].platform,
+        "device_kind": jax.devices()[0].device_kind,
+        "shapes": {"batch": batch, "seq_per_shard": 32,
+                   "d_model": dmodel, "d_ff": dff},
+    }
+    if jax.devices()[0].platform == "cpu":
+        out["note"] = (
+            "virtual CPU devices serialize the ring onto one core, so "
+            "overlap cannot win here; the harness is meaningful on a "
+            "real slice, where each hop's transfer runs beside the "
+            "chunk dot"
         )
     print(json.dumps(out, indent=2))
 
@@ -520,6 +665,34 @@ def _spawn(args: list[str], timeout_s: float, env=None):
 def _json_line(stdout: str):
     lines = [l for l in stdout.splitlines() if l.startswith("{")]
     return lines[-1] if lines else None
+
+
+def _run_sweep_child(child_args: list[str], env, key: str) -> None:
+    """Run a sweep child (--scaling / --cm-microbench) and forward its
+    table; on failure, RESCUE the per-leg partial lines it printed
+    before dying (VERDICT r5: a relay that wedges mid-round must not
+    erase the legs that already ran) into one diagnostic JSON with the
+    'backend': 'unreachable' convention."""
+    rc, out, err = _spawn(child_args, TOTAL_BUDGET_S, env=env)
+    if rc == 0 and out.strip():
+        print(out, end="", flush=True)
+        return
+    legs = []
+    for line in (out or "").splitlines():
+        if not line.startswith("{"):
+            continue
+        try:
+            parsed = json.loads(line)
+        except json.JSONDecodeError:
+            continue
+        if "leg" in parsed:
+            legs.append(parsed["leg"])
+    # emit() keeps the metric/value/unit/vs_baseline schema every other
+    # failure path guarantees, so scoreboard consumers never KeyError on
+    # a failed sweep round.
+    emit(0.0, 0.0, backend="unreachable",
+         error=f"sweep child failed (rc={rc}): {(err or out)[-300:]}",
+         **{key: legs})
 
 
 def main() -> None:
@@ -648,17 +821,34 @@ if __name__ == "__main__":
              "its chips",
     )
     parser.add_argument(
+        "--cm-microbench", action="store_true",
+        help="print a naive-vs-overlapped collective-matmul table "
+             "(latency-hiding chunked rings, ops/collective_matmul.py) "
+             "instead of the single benchmark line; devices from "
+             "--scaling-platform / --max-devices",
+    )
+    parser.add_argument(
         "--child", action="store_true",
         help="internal: run a measurement in-process (spawned by main)",
     )
     parser.add_argument("--child-scaling", action="store_true",
                         help="internal: run the scaling sweep in-process")
+    parser.add_argument("--child-cm", action="store_true",
+                        help="internal: run the collective-matmul "
+                             "microbench in-process")
     parser.add_argument("--child-model", default="mobilenetv2")
     parser.add_argument("--child-batch", type=int, default=512)
     parser.add_argument("--child-dtypes", default="bfloat16,float32")
     parser.add_argument("--child-cpu", action="store_true",
                         help="internal: force the virtual-CPU mesh")
     args = parser.parse_args()
+
+    if args.scaling and args.cm_microbench:
+        parser.error(
+            "--scaling and --cm-microbench are mutually exclusive "
+            "(one sweep per invocation; running both would silently "
+            "drop one table)"
+        )
 
     if args.child:
         run_child(args.child_model, args.child_batch,
@@ -667,6 +857,9 @@ if __name__ == "__main__":
     if args.child_scaling:
         run_child_scaling(args.max_devices, args.scaling_model,
                           args.scaling_platform)
+        sys.exit(0)
+    if args.child_cm:
+        run_child_cm(args.max_devices, args.scaling_platform)
         sys.exit(0)
 
     def on_alarm(signum, frame):
@@ -681,23 +874,26 @@ if __name__ == "__main__":
     signal.signal(signal.SIGALRM, on_alarm)
     signal.alarm(TOTAL_BUDGET_S + 30)
     try:
-        if args.scaling:
+        if args.scaling or args.cm_microbench:
             env = (
                 _cpu_child_env(args.max_devices)
                 if args.scaling_platform == "cpu" else None
             )
-            rc, out, err = _spawn(
-                ["--child-scaling", "--max-devices", str(args.max_devices),
-                 "--scaling-model", args.scaling_model,
-                 "--scaling-platform", args.scaling_platform],
-                TOTAL_BUDGET_S, env=env,
-            )
-            if rc == 0 and out.strip():
-                print(out, end="", flush=True)
+            if args.scaling:
+                _run_sweep_child(
+                    ["--child-scaling",
+                     "--max-devices", str(args.max_devices),
+                     "--scaling-model", args.scaling_model,
+                     "--scaling-platform", args.scaling_platform],
+                    env, "scaling",
+                )
             else:
-                emit(0.0, 0.0,
-                     error=f"scaling child failed (rc={rc}): "
-                           f"{(err or out)[-300:]}")
+                _run_sweep_child(
+                    ["--child-cm",
+                     "--max-devices", str(args.max_devices),
+                     "--scaling-platform", args.scaling_platform],
+                    env, "collective_matmul_microbench",
+                )
         else:
             main()
     except Exception as e:  # noqa: BLE001 — rc must stay 0 with a JSON line

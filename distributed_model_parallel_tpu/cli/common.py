@@ -73,6 +73,13 @@ MODELS = {
     ),
 }
 
+# Models whose blocks route every projection through `layers.project` —
+# the collective-matmul hook (`ops/collective_matmul.py`). Kept beside
+# MODELS so a new transformer-family entry extends both in one place;
+# --collective-matmul is rejected for models outside this set (the flag
+# would silently do nothing).
+TRANSFORMER_MODELS = ("bert", "bert_tiny", "vit")
+
 # Pipeline stage builders, kept beside MODELS so both CLIs extend in one
 # place: name -> fn(num_stages, num_classes, boundaries) -> [Layer].
 STAGE_BUILDERS = {

@@ -71,12 +71,26 @@ def build_parser() -> argparse.ArgumentParser:
                         help="native augmentation thread-pool size")
     # -- TPU-native additions --------------------------------------------
     parser.add_argument("--engine", default="gspmd",
-                        choices=("gspmd", "ddp", "fsdp"),
+                        choices=("gspmd", "ddp", "fsdp", "tp"),
                         help="gspmd: compiler-partitioned (nn.DataParallel "
                              "equivalent); ddp: explicit shard_map psum "
                              "(DistributedDataParallel equivalent); fsdp: "
                              "params+optimizer sharded 1/N over 'data' "
-                             "(ZeRO-3 equivalent)")
+                             "(ZeRO-3 equivalent); tp: Megatron tensor "
+                             "parallelism over a 'model' axis "
+                             "(--model-shards; transformer-family models)")
+    parser.add_argument("--model-shards", default=1, type=int,
+                        help="'model' mesh axis size under --engine tp "
+                             "(remaining devices become data-parallel "
+                             "replicas)")
+    parser.add_argument("--collective-matmul", action="store_true",
+                        help="latency-hiding collective matmul under "
+                             "--engine tp: run the Megatron projections "
+                             "as chunked ppermute rings that overlap "
+                             "each ICI hop with the partial dot instead "
+                             "of the partitioner's monolithic "
+                             "all-gather/reduce-scatter (same math; "
+                             "transformer-family models)")
     parser.add_argument("--max-restarts", default=0, type=int,
                         help="fail-fast elastic mode: restart from the "
                              "per-epoch checkpoint up to N times on "
@@ -116,8 +130,47 @@ def main(argv=None) -> dict:
             )
         if not os.path.exists(args.finetune):
             raise SystemExit(f"--finetune: no such file {args.finetune!r}")
+    if args.engine != "tp":
+        if args.model_shards != 1:
+            raise SystemExit(
+                "--model-shards sizes the 'model' mesh axis and only "
+                "applies under --engine tp"
+            )
+        if args.collective_matmul:
+            raise SystemExit(
+                "--collective-matmul decomposes the Megatron TP "
+                "projections; it only applies under --engine tp"
+            )
+    if args.engine == "tp":
+        from distributed_model_parallel_tpu.cli.common import (
+            TRANSFORMER_MODELS,
+        )
+
+        if args.model not in TRANSFORMER_MODELS:
+            # MEGATRON_RULES match transformer projection paths only; a
+            # CNN under --engine tp would replicate every weight and do
+            # redundant compute on the 'model' axis without an error.
+            raise SystemExit(
+                "--engine tp shards the Megatron projection layers; "
+                f"--model {args.model} has none, so every weight would "
+                "silently replicate across the 'model' axis (redundant "
+                f"compute). Choose one of {', '.join(TRANSFORMER_MODELS)}."
+            )
+        if args.model_shards < 1:
+            raise SystemExit(
+                f"--model-shards must be >= 1, got {args.model_shards}"
+            )
+        if args.collective_matmul and args.model_shards < 2:
+            raise SystemExit(
+                "--collective-matmul rings over the 'model' axis; a "
+                "size-1 ring is a plain dot, so the flag would silently "
+                "do nothing — set --model-shards >= 2"
+            )
     initialize_backend()
-    mesh = make_mesh(MeshSpec(data=-1))
+    if args.engine == "tp":
+        mesh = make_mesh(MeshSpec(data=-1, model=args.model_shards))
+    else:
+        mesh = make_mesh(MeshSpec(data=-1))
     check_batch_divisibility(args.batch_size, mesh)
     check_batch_divisibility(args.val_batch_size, mesh, label="val batch")
     if args.dataset_type == "SyntheticText" and (
@@ -170,6 +223,15 @@ def main(argv=None) -> dict:
 
         engine = FSDPEngine(
             model, opt, mesh, compute_dtype=cdt, input_transform=itf
+        )
+    elif args.engine == "tp":
+        from distributed_model_parallel_tpu.parallel.tensor_parallel import (
+            TensorParallelEngine,
+        )
+
+        engine = TensorParallelEngine(
+            model, opt, mesh, compute_dtype=cdt, input_transform=itf,
+            collective_matmul=args.collective_matmul,
         )
     else:
         engine = DataParallelEngine(

@@ -89,6 +89,13 @@ def build_parser() -> argparse.ArgumentParser:
                             "ulysses_flash"),
                    help="*_flash = Pallas kernels as the attention core "
                         "(the long-context hot paths on TPU)")
+    p.add_argument("--collective-matmul", action="store_true",
+                   help="latency-hiding collective matmul (seq-parallel "
+                        "mode): run each block's FFN pair as chunked "
+                        "ppermute rings over 'seq' — every ICI hop "
+                        "overlaps the partial dot already on hand "
+                        "(same math; requires --ffn-dim divisible by "
+                        "--seq-shards)")
     p.add_argument("--dtype", default="float32",
                    choices=("float32", "bfloat16"))
     p.add_argument("--remat", action="store_true")
@@ -109,6 +116,18 @@ def main(argv=None) -> dict:
         raise SystemExit(
             "--pipeline-stages and --seq-shards are mutually exclusive "
             "(one engine per run; compose data parallelism with either)"
+        )
+    if args.pipeline_stages > 1 and args.collective_matmul:
+        raise SystemExit(
+            "--collective-matmul decomposes the sequence-parallel "
+            "engine's FFN collectives; it has no effect under "
+            "--pipeline-stages (stages compute dense locally)"
+        )
+    if args.collective_matmul and args.seq_shards < 2:
+        raise SystemExit(
+            "--collective-matmul rings over the 'seq' axis; a size-1 "
+            "ring is a plain dot, so the flag would silently do "
+            "nothing — set --seq-shards >= 2"
         )
     if args.pipeline_stages > 1 and args.attention != "ring":
         # The --attention choices are 'seq'-axis DISTRIBUTION patterns;
@@ -184,6 +203,7 @@ def main(argv=None) -> dict:
             cfg, build_optimizer(args), mesh, attention=args.attention,
             compute_dtype=compute_dtype_from_flag(args.dtype),
             remat=args.remat,
+            collective_matmul=args.collective_matmul,
         )
     corpus = synthetic_corpus(
         args.vocab_size, args.corpus_tokens, seed=args.corpus_seed

@@ -14,6 +14,13 @@ as `ids != 0` — so the whole model is a standard `Layer` and every engine
 Stage splitting for pipeline parallelism follows the shared staging
 convention: embeddings = stem, encoder layers = blocks, pooler+classifier
 = head.
+
+The encoder blocks are `models/transformer.py` wholesale, so every
+projection matmul rides the `layers.project` collective-matmul hook:
+under `TensorParallelEngine(collective_matmul=True)` /
+`SequenceParallelEngine(collective_matmul=True)` the qkv/out and ffn
+in/out matmuls run as latency-hiding chunked ppermute rings
+(`ops/collective_matmul.py`) with no model change.
 """
 
 from __future__ import annotations

@@ -10,8 +10,11 @@ the flash kernel by swapping `attention_fn`).
 Shapes: int32 ids (B, T) -> logits (B, T, vocab). Training uses
 `lm_loss` (next-token shift, padding-aware). The decoder block IS the
 encoder block with a causal attention_fn — post-LN, like GPT-1; the
-blocks reuse `models/transformer.py` wholesale, so TP's MEGATRON_RULES
-and the pipeline stage splitter apply to the block stack unchanged.
+blocks reuse `models/transformer.py` wholesale, so TP's MEGATRON_RULES,
+the pipeline stage splitter, AND the collective-matmul hook
+(`layers.project`; chunked ppermute rings under
+`collective_matmul=True`, `ops/collective_matmul.py`) apply to the
+block stack unchanged.
 (The classification engines' train loops expect (B, C) logits + integer
 labels; LM training drives this model with `lm_loss` under plain
 jit/grad — see tests/test_gpt.py for the data-parallel recipe.)

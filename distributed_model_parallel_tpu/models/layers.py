@@ -55,6 +55,12 @@ class Context:
     # cast their output to it; everything downstream follows x.dtype, and
     # params stay f32 master copies (cast per-use inside each layer).
     dtype: Optional[Any] = None
+    # Latency-hiding collective-matmul policy
+    # (`ops.collective_matmul.CollectiveMatmul` / `LocalCollectiveMatmul`)
+    # threaded by the TP/SP engines when `collective_matmul=True`; the
+    # transformer-family projection layers consume it via `project`.
+    # None => every projection is a plain dot (the default everywhere).
+    matmul: Optional[Any] = None
 
     def child(self, i: int) -> "Context":
         """Context for the i-th child of a combinator: folds the child
@@ -227,6 +233,23 @@ def embedding(vocab: int, dim: int, *, scale: float = 0.02) -> Layer:
         return out, state
 
     return Layer(init, apply)
+
+
+def project(h, w, b, ctx: Context, *, role: str, scope: str):
+    """Dense projection with the collective-matmul hook.
+
+    The transformer-family attention/MLP layers route every weight
+    matmul through here. When an engine threads a policy into
+    `ctx.matmul` (TP/SP engines with `collective_matmul=True`) and the
+    policy opts `scope` in ('attn' | 'ffn'), 'column'-role projections
+    (qkv / ffn-in) run as chunked `ag_matmul` ppermute rings and
+    'row'-role ones (attn-out / ffn-out) as `matmul_rs` rings
+    (`ops/collective_matmul.py`); otherwise this is exactly `h @ w + b`.
+    """
+    mm = ctx.matmul
+    if mm is not None and getattr(mm, scope):
+        return (mm.column if role == "column" else mm.row)(h, w, b)
+    return h @ w + b
 
 
 # ---------------------------------------------------------------------------

@@ -13,7 +13,12 @@ Attention math routes through the `attention_fn` parameter (default
 stack sequence-parallel (tests/test_sequence_parallel.py). Head-dimension
 projections are single fused (D, 3D)/(D, D) matmuls — the layout
 `parallel.tensor_parallel.TensorParallelEngine` shards on the 'model'
-axis via `MEGATRON_RULES`.
+axis via `MEGATRON_RULES`. Every projection routes through
+`layers.project`, the collective-matmul hook: engines constructed with
+`collective_matmul=True` thread a chunked-ppermute policy through
+`Context.matmul` and the qkv/out/ffn matmuls overlap their collectives
+with compute (`ops/collective_matmul.py`) instead of relying on the
+partitioner's monolithic all-gather/reduce-scatter.
 """
 
 from __future__ import annotations
@@ -61,13 +66,22 @@ def multi_head_attention(
     def apply(params, state, x, ctx):
         h, mask = x
         b, t, _ = h.shape
-        qkv = h @ params["qkv"]["w"] + params["qkv"]["b"]
+        # Column-parallel projection: under a collective-matmul policy
+        # (ctx.matmul, TP engines) this is a chunked ag_matmul ring.
+        qkv = L.project(
+            h, params["qkv"]["w"], params["qkv"]["b"], ctx,
+            role="column", scope="attn",
+        )
         q, k, v = jnp.split(qkv, 3, axis=-1)
         q = q.reshape(b, t, num_heads, dh)
         k = k.reshape(b, t, num_heads, dh)
         v = v.reshape(b, t, num_heads, dh)
         o = attention_fn(q, k, v, mask)
-        o = o.reshape(b, t, dim) @ params["out"]["w"] + params["out"]["b"]
+        # Row-parallel projection: matmul_rs ring under the policy.
+        o = L.project(
+            o.reshape(b, t, dim), params["out"]["w"], params["out"]["b"],
+            ctx, role="row", scope="attn",
+        )
         o, _ = drop.apply({}, {}, o, ctx)
         return (o, mask), state
 
@@ -89,9 +103,16 @@ def feed_forward(
 
     def apply(params, state, x, ctx):
         h, mask = x
-        y = jax.nn.gelu(h @ params["in"]["w"] + params["in"]["b"],
-                        approximate=False)
-        y = y @ params["out"]["w"] + params["out"]["b"]
+        # The column->row pair: one ag_matmul + one matmul_rs per block
+        # under a collective-matmul policy (ctx.matmul); plain dots
+        # otherwise.
+        y = jax.nn.gelu(
+            L.project(h, params["in"]["w"], params["in"]["b"], ctx,
+                      role="column", scope="ffn"),
+            approximate=False,
+        )
+        y = L.project(y, params["out"]["w"], params["out"]["b"], ctx,
+                      role="row", scope="ffn")
         y, _ = drop.apply({}, {}, y, ctx)
         return (y, mask), state
 

@@ -2,11 +2,19 @@
 attention model; BERT-base is demanded by BASELINE.json's configs), their
 sequence-parallel variants (ring attention over ppermute, Ulysses
 all-to-all, and ring_flash_attention — the ring with the fused Pallas
-kernels as its per-hop core), and the Pallas flash-attention kernels
-(forward + backward) for the single-chip hot path."""
+kernels as its per-hop core), the Pallas flash-attention kernels
+(forward + backward) for the single-chip hot path, and the
+latency-hiding collective matmuls (chunked ppermute ag_matmul /
+matmul_rs for the TP/SP projection layers)."""
 
 from distributed_model_parallel_tpu.ops.attention import (  # noqa: F401
     dot_product_attention,
+)
+from distributed_model_parallel_tpu.ops.collective_matmul import (  # noqa: F401
+    CollectiveMatmul,
+    LocalCollectiveMatmul,
+    ag_matmul,
+    matmul_rs,
 )
 from distributed_model_parallel_tpu.ops.pallas_attention import (  # noqa: F401
     flash_attention,

@@ -1,0 +1,447 @@
+"""Latency-hiding collective matmul: chunked `ppermute` rings that
+overlap tensor/sequence-parallel collectives with the matmuls that
+consume them.
+
+The declarative engines (`parallel/tensor_parallel.py` and friends) let
+the XLA SPMD partitioner insert monolithic `all-gather` /
+`reduce-scatter` / `all-reduce` ops around the Megatron matmuls and hope
+the scheduler finds overlap. Production TPU stacks do better by
+DECOMPOSING the collective ("Overlap Communication with Dependent
+Computation via Decomposition", Wang et al., ASPLOS 2023; GSPMD, Xu et
+al., 2021): break the gathered operand into S per-shard chunks, move one
+chunk per `lax.ppermute` hop around the ICI ring, and run the partial
+matmul for the chunk already on hand while the next hop is in flight.
+The collective's latency hides behind the dot it feeds.
+
+Two kernels, each exactly S-1 `collective-permute`s (pinned from the
+lowered HLO in tests/test_collectives_hlo.py — no monolithic
+all-gather/reduce-scatter remains on an opted-in matmul):
+
+* `ag_matmul(x, w, axis_name)`   — all-gather-then-matmul. x is
+  (..., T/S, D) row-sharded, w is (D, F/S) column-sharded; returns
+  (..., T, F/S). Chunks of x ring around the axis; each arrival fires
+  the partial dot for the rows it carries.
+* `matmul_rs(x, w, axis_name)`   — matmul-then-reduce-scatter. x is
+  (..., T, F/S) column-sharded, w is (F/S, D) row-sharded; returns
+  (..., T/S, D). Partial-sum accumulators ring around the axis, each
+  hop's dot (the NEXT chunk's partial product) overlapping the
+  accumulator transfer.
+
+When the axis size is even, both kernels split the ring in two and send
+chunks both directions at once (bidirectional ring): the same S-1 total
+hops finish in ceil((S-1)/2) serial steps, halving the latency to hide.
+Odd sizes run a single ring.
+
+Both carry a `jax.custom_vjp` so the backward pass runs the DUAL kernel
+instead of transposing the forward's gather chunk-by-chunk through
+autodiff: d(ag_matmul)/dx is a matmul_rs ring, d(matmul_rs)/dx is an
+ag_matmul ring (fused with the dw accumulation off the same hops). Every
+backward is itself S-1-permute chunked — no monolithic collective
+appears in either direction.
+
+Engine wiring (all opt-in via `collective_matmul=True`, default off):
+
+* `CollectiveMatmul` — the jit-level policy for the GSPMD
+  `TensorParallelEngine`: each opted-in projection becomes a shard_map
+  region over the 'model' axis whose in/out specs match the Megatron
+  layout the engine already places on the weights (entering costs a
+  local slice, never a collective). Between the column- and row-parallel
+  matmuls of a block the activations are exactly where the declarative
+  engine puts them (feature/head-sharded), so attention math is
+  untouched; outside the pair the residual stream rides sequence-sharded
+  over 'model' (Megatron-SP, Korthikanti et al. 2022).
+* `LocalCollectiveMatmul` — the shard_map-level policy for the
+  sequence-parallel engines (which already run under one big shard_map
+  over ('data','seq')): weights stay replicated in storage (checkpoints
+  interoperate), each shard SLICES its column/row block by axis index,
+  and the FFN pair runs gather->matmul / matmul->scatter over 'seq'.
+  Attention projections keep the local math (`attn=False`): their
+  outputs feed the K/V ring, which needs sequence-sharded, all-head
+  activations.
+
+The policies are threaded through `models.layers.Context.matmul` and
+consumed by `models.layers.project` — the single projection hook the
+transformer/BERT/GPT attention and MLP layers call.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+from typing import Any, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+from distributed_model_parallel_tpu.runtime.compat import shard_map
+
+
+def _axis_size(axis_name) -> int:
+    """Static size of a shard_map axis (psum of a Python literal is
+    constant-folded to the axis size — never a tracer)."""
+    return int(lax.psum(1, axis_name))
+
+
+def _split(size: int) -> Tuple[int, int]:
+    """(hops on the ascending ring, hops on the descending ring).
+
+    Even axis sizes use both ICI directions at once — S-1 total hops in
+    ceil((S-1)/2) serial steps; odd sizes run a single ring."""
+    if size % 2 == 0:
+        n_up = size // 2
+        return n_up, size - 1 - n_up
+    return size - 1, 0
+
+
+def _perms(size: int):
+    up = [(j, (j + 1) % size) for j in range(size)]
+    dn = [(j, (j - 1) % size) for j in range(size)]
+    return up, dn
+
+
+def _flat(a):
+    """(..., R, C) -> (prod(...)*R, C): contraction view for dw."""
+    return a.reshape(-1, a.shape[-1])
+
+
+def _ring_fold(seed, axis_name, carry, fold):
+    """The shared ring skeleton every chunked kernel here rides: ring
+    `seed` (this shard's chunk) S-1 hops around `axis_name` — both
+    directions at once when S is even — calling
+    `carry = fold(carry, chunk, offset)` for the resident chunk
+    (offset 0) and each arrival. `offset` is the signed ring distance of
+    the chunk's origin shard: an up-ring arrival at hop r came from
+    shard i-r (offset -r), a down-ring one from i+r (offset +r).
+
+    One skeleton by construction: the forward gather, the dw fold, and
+    the fused rs-backward differ only in their fold body, so a change to
+    the hop schedule cannot diverge them. Per hop, the fold's dot is
+    independent of the permute in flight — the overlap the decomposition
+    exists for."""
+    carry = fold(carry, seed, 0)
+    size = _axis_size(axis_name)
+    if size == 1:
+        return carry
+    n_up, n_dn = _split(size)
+    up, dn = _perms(size)
+    fwd = bwd = seed
+    for r in range(1, max(n_up, n_dn) + 1):
+        if r <= n_up:
+            fwd = lax.ppermute(fwd, axis_name, up)
+        if r <= n_dn:
+            bwd = lax.ppermute(bwd, axis_name, dn)
+        if r <= n_up:
+            carry = fold(carry, fwd, -r)
+        if r <= n_dn:
+            carry = fold(carry, bwd, +r)
+    return carry
+
+
+# --------------------------------------------------------------- forward
+
+
+def _ag_matmul_impl(x, w, axis_name):
+    """All-gather-then-matmul, gather decomposed into S-1 ppermutes."""
+    size = _axis_size(axis_name)
+    if size == 1:
+        return x @ w
+    i = lax.axis_index(axis_name)
+    tl = x.shape[-2]
+    out = jnp.zeros(
+        (*x.shape[:-2], size * tl, w.shape[-1]), jnp.result_type(x, w)
+    )
+
+    def fold(buf, chunk, off):
+        # The chunk originated at shard i+off; its rows belong at that
+        # global offset.
+        return lax.dynamic_update_slice_in_dim(
+            buf, chunk @ w, ((i + off) % size) * tl, axis=-2
+        )
+
+    return _ring_fold(x, axis_name, out, fold)
+
+
+def _matmul_rs_impl(x, w, axis_name):
+    """Matmul-then-reduce-scatter, scatter decomposed into S-1 ppermutes.
+
+    Partial-sum accumulators travel the ring toward their destination
+    shard; each device folds in its own partial dot for the chunk the
+    arriving accumulator is destined for. The dots don't depend on the
+    permutes, so they fill the hop latency."""
+    size = _axis_size(axis_name)
+    if size == 1:
+        return x @ w
+    i = lax.axis_index(axis_name)
+    t = x.shape[-2]
+    if t % size != 0:
+        raise ValueError(
+            f"matmul_rs: row count {t} not divisible by axis "
+            f"{axis_name!r} size {size}"
+        )
+    tl = t // size
+
+    def pchunk(c):
+        xc = lax.dynamic_slice_in_dim(x, (c % size) * tl, tl, axis=-2)
+        return xc @ w
+
+    n_up, n_dn = _split(size)
+    up, dn = _perms(size)
+    out = pchunk(i)
+    if n_up:
+        acc = pchunk(i + n_up)
+        for r in range(n_up - 1, 0, -1):
+            acc = lax.ppermute(acc, axis_name, up) + pchunk(i + r)
+        out = out + lax.ppermute(acc, axis_name, up)
+    if n_dn:
+        acc = pchunk(i - n_dn)
+        for r in range(n_dn - 1, 0, -1):
+            acc = lax.ppermute(acc, axis_name, dn) + pchunk(i - r)
+        out = out + lax.ppermute(acc, axis_name, dn)
+    return out
+
+
+# -------------------------------------------------------------- backward
+
+
+def _ag_dw_ring(x, dy, axis_name):
+    """dw = gathered(x)^T @ dy without a gather: ring x's chunks (the
+    same S-1 hops as the forward) and fold each arrival's outer product
+    with the matching rows of the resident dy."""
+    size = _axis_size(axis_name)
+    i = lax.axis_index(axis_name)
+    tl = x.shape[-2]
+
+    def dchunk(c):
+        return lax.dynamic_slice_in_dim(
+            dy, (c % size) * tl, tl, axis=-2
+        )
+
+    def fold(dw, chunk, off):
+        return dw + _flat(chunk).T @ _flat(dchunk(i + off))
+
+    dw = jnp.zeros((x.shape[-1], dy.shape[-1]), jnp.result_type(x, dy))
+    return _ring_fold(x, axis_name, dw, fold)
+
+
+def _rs_bwd_ring(x, w, dy, axis_name):
+    """matmul_rs backward, both cotangents off ONE dy-ring:
+    dx = gathered(dy) @ w^T (the dual ag_matmul) and dw = x^T @
+    gathered(dy), folded per arriving chunk — S-1 hops total."""
+    size = _axis_size(axis_name)
+    i = lax.axis_index(axis_name)
+    tl = dy.shape[-2]
+
+    def xchunk(c):
+        return lax.dynamic_slice_in_dim(
+            x, (c % size) * tl, tl, axis=-2
+        )
+
+    def fold(carry, dyc, off):
+        dx, dw = carry
+        src = (i + off) % size
+        dx = lax.dynamic_update_slice_in_dim(
+            dx, dyc @ w.T, src * tl, axis=-2
+        )
+        dw = dw + _flat(xchunk(src)).T @ _flat(dyc)
+        return dx, dw
+
+    dx = jnp.zeros(
+        (*dy.shape[:-2], size * tl, w.shape[0]), jnp.result_type(dy, w)
+    )
+    dw = jnp.zeros(w.shape, jnp.result_type(x, dy))
+    return _ring_fold(dy, axis_name, (dx, dw), fold)
+
+
+# --------------------------------------------------------- public kernels
+
+
+@partial(jax.custom_vjp, nondiff_argnums=(2,))
+def ag_matmul(x, w, axis_name):
+    """gathered(x) @ w over `axis_name`, gather chunked into S-1
+    overlapped ppermutes. x (..., T/S, D) row-sharded, w (D, F/S);
+    returns (..., T, F/S). Backward: dx via the dual matmul_rs ring,
+    dw via an x-ring — both chunked."""
+    return _ag_matmul_impl(x, w, axis_name)
+
+
+def _ag_fwd(x, w, axis_name):
+    return _ag_matmul_impl(x, w, axis_name), (x, w)
+
+
+def _ag_bwd(axis_name, res, dy):
+    x, w = res
+    dx = _matmul_rs_impl(dy, w.T, axis_name)
+    dw = _ag_dw_ring(x, dy, axis_name)
+    return dx.astype(x.dtype), dw.astype(w.dtype)
+
+
+ag_matmul.defvjp(_ag_fwd, _ag_bwd)
+
+
+@partial(jax.custom_vjp, nondiff_argnums=(2,))
+def matmul_rs(x, w, axis_name):
+    """reduce_scatter(x @ w) over `axis_name`, scatter chunked into S-1
+    overlapped ppermutes. x (..., T, F/S) column-sharded, w (F/S, D);
+    returns (..., T/S, D). Backward: dx via the dual ag_matmul ring,
+    dw folded off the same hops."""
+    return _matmul_rs_impl(x, w, axis_name)
+
+
+def _rs_fwd(x, w, axis_name):
+    return _matmul_rs_impl(x, w, axis_name), (x, w)
+
+
+def _rs_bwd(axis_name, res, dy):
+    x, w = res
+    dx, dw = _rs_bwd_ring(x, w, dy, axis_name)
+    return dx.astype(x.dtype), dw.astype(w.dtype)
+
+
+matmul_rs.defvjp(_rs_fwd, _rs_bwd)
+
+
+# ----------------------------------------------------- naive references
+
+
+def naive_ag_matmul(x, w, axis_name):
+    """The monolithic baseline: one all_gather, then the matmul. Used by
+    the parity tests and the bench's naive-vs-overlapped microbench."""
+    return lax.all_gather(x, axis_name, axis=x.ndim - 2, tiled=True) @ w
+
+
+def naive_matmul_rs(x, w, axis_name):
+    """The monolithic baseline: the matmul, then one psum_scatter."""
+    y = x @ w
+    return lax.psum_scatter(
+        y, axis_name, scatter_dimension=y.ndim - 2, tiled=True
+    )
+
+
+# ------------------------------------------------------ engine policies
+
+
+def _check_div(what: str, n: int, size: int, label: str) -> None:
+    if n % size != 0:
+        raise ValueError(
+            f"collective_matmul: {label} ({n}) must be divisible by the "
+            f"ring size ({size}) for the {what} chunking"
+        )
+
+
+@dataclasses.dataclass(frozen=True)
+class CollectiveMatmul:
+    """jit-level policy for the GSPMD engines (TensorParallelEngine).
+
+    Each opted-in projection runs as a shard_map region over `axis`;
+    the in/out specs match the Megatron weight layout the engine already
+    pins, so region entry is a local slice, never a collective. The
+    residual stream between blocks rides sequence-sharded over `axis`
+    (Megatron-SP); inside the column->row pair, activations sit exactly
+    where the declarative engine puts them (feature/head-sharded), so
+    attention math and the rest of the model are untouched."""
+
+    mesh: Mesh
+    axis: str = "model"
+    batch_axes: Tuple[str, ...] = ("data",)
+    attn: bool = True
+    ffn: bool = True
+
+    def _size(self) -> int:
+        return self.mesh.shape[self.axis]
+
+    def column(self, h, w, b):
+        """h (B, T, D) -> (B, T, F): F-sharded out, T gathered via the
+        ag_matmul ring (h enters T-sharded: a free slice whether the
+        producer left it sequence-sharded or replicated)."""
+        size = self._size()
+        _check_div("column", h.shape[-2], size, "sequence length")
+        _check_div("column", w.shape[-1], size, "output features")
+        bs = self.batch_axes
+        fn = shard_map(
+            partial(_column_local, axis_name=self.axis),
+            mesh=self.mesh,
+            in_specs=(P(bs, self.axis, None), P(None, self.axis),
+                      P(self.axis)),
+            out_specs=P(bs, None, self.axis),
+            check_vma=False,
+        )
+        return fn(h, w, b)
+
+    def row(self, h, w, b):
+        """h (B, T, F) F-sharded -> (B, T, D): partial sums
+        reduce-scattered onto the sequence dim via the matmul_rs ring."""
+        size = self._size()
+        _check_div("row", h.shape[-2], size, "sequence length")
+        _check_div("row", w.shape[0], size, "input features")
+        bs = self.batch_axes
+        fn = shard_map(
+            partial(_row_local, axis_name=self.axis),
+            mesh=self.mesh,
+            in_specs=(P(bs, None, self.axis), P(self.axis, None), P()),
+            out_specs=P(bs, self.axis, None),
+            check_vma=False,
+        )
+        return fn(h, w, b)
+
+
+def _column_local(hl, wl, bl, *, axis_name):
+    return ag_matmul(hl, wl, axis_name) + bl
+
+
+def _row_local(hl, wl, b, *, axis_name):
+    return matmul_rs(hl, wl, axis_name) + b
+
+
+@dataclasses.dataclass(frozen=True)
+class LocalCollectiveMatmul:
+    """shard_map-level policy for the sequence-parallel engines.
+
+    Called INSIDE the engine's existing shard_map over ('data', 'seq'):
+    weights stay replicated in storage (checkpoints and the dense-twin
+    init interoperate); each shard slices its column/row block by axis
+    index — the slice transpose scatters the block's gradient back into
+    the full-shape cotangent, which the engine's post-grad psum('seq')
+    reassembles, exactly like every other SP parameter.
+
+    Default `attn=False`: the SP attention projections must stay local —
+    their outputs feed the K/V ring / all-to-all, which consumes
+    sequence-sharded, all-head activations. The FFN pair is the
+    gather->matmul / matmul->scatter site."""
+
+    axis: str = "seq"
+    attn: bool = False
+    ffn: bool = True
+
+    def column(self, h, w, b):
+        """h (B, T/S, D) local -> (B, T, F/S): my column block of the
+        FFN input projection over every shard's tokens."""
+        size = _axis_size(self.axis)
+        _check_div("column", w.shape[-1], size, "output features")
+        i = lax.axis_index(self.axis)
+        fl = w.shape[-1] // size
+        wl = lax.dynamic_slice_in_dim(w, i * fl, fl, axis=-1)
+        bl = lax.dynamic_slice_in_dim(b, i * fl, fl, axis=0)
+        return ag_matmul(h, wl, self.axis) + bl
+
+    def row(self, h, w, b):
+        """h (B, T, F/S) -> (B, T/S, D): my row block's partial sums,
+        reduce-scattered back onto this shard's tokens. The (replicated)
+        bias is added once per token row — on the owning shard."""
+        size = _axis_size(self.axis)
+        _check_div("row", w.shape[0], size, "input features")
+        i = lax.axis_index(self.axis)
+        fl = w.shape[0] // size
+        wl = lax.dynamic_slice_in_dim(w, i * fl, fl, axis=0)
+        return matmul_rs(h, wl, self.axis) + b
+
+
+__all__ = [
+    "CollectiveMatmul",
+    "LocalCollectiveMatmul",
+    "ag_matmul",
+    "matmul_rs",
+    "naive_ag_matmul",
+    "naive_matmul_rs",
+]

@@ -93,6 +93,25 @@ def _check_seq_len(ids, max_position: int, cfg_name: str) -> None:
         )
 
 
+def _seq_matmul_policy(enabled: bool, ffn_dim: int, seq_shards: int):
+    """Collective-matmul policy for the SP engines (or None when off):
+    `LocalCollectiveMatmul` over 'seq', FFN pair only — validated here so
+    a non-divisible FFN width fails at construction, not an epoch in."""
+    if not enabled:
+        return None
+    if ffn_dim % seq_shards:
+        raise ValueError(
+            f"collective_matmul=True chunks the FFN width over the "
+            f"'seq' axis: intermediate/ffn dim {ffn_dim} must be "
+            f"divisible by the {seq_shards} sequence shards"
+        )
+    from distributed_model_parallel_tpu.ops.collective_matmul import (
+        LocalCollectiveMatmul,
+    )
+
+    return LocalCollectiveMatmul(axis="seq")
+
+
 @dataclasses.dataclass
 class SequenceParallelEngine:
     """BERT-family classification training with 'seq'-sharded activations.
@@ -112,6 +131,15 @@ class SequenceParallelEngine:
     compute_dtype: Any = None
     # Rematerialize each transformer block during backward (jax.checkpoint).
     remat: bool = False
+    # Latency-hiding collective matmul (default off): the FFN pair of
+    # every block runs as chunked ppermute rings over 'seq' — each shard
+    # slices its column/row block of the (replicated-in-storage) FFN
+    # weights, gathers tokens via ag_matmul and scatters partial sums
+    # back via matmul_rs, overlapping every hop with the chunk dot
+    # (`ops/collective_matmul.py::LocalCollectiveMatmul`). Attention
+    # projections stay local (their outputs feed the K/V ring). Same
+    # math — parity pinned in tests/test_collective_matmul.py.
+    collective_matmul: bool = False
 
     def __post_init__(self):
         mesh = self.mesh
@@ -135,6 +163,11 @@ class SequenceParallelEngine:
                 "DP / DDP / TensorParallel / ExpertParallel engines."
             )
         attn_fn = partial(ATTENTION[self.attention], axis_name="seq")
+        self._matmul = _seq_matmul_policy(
+            self.collective_matmul, cfg.intermediate_size,
+            mesh.shape["seq"],
+        )
+        mm = self._matmul
         self._repl = NamedSharding(mesh, P())
         self._batch = NamedSharding(mesh, P(("data",), ("seq",)))
         self._labels = NamedSharding(mesh, P(("data",)))
@@ -187,7 +220,7 @@ class SequenceParallelEngine:
                 ),
                 lax.axis_index("seq"),
             )
-            ctx = L.Context(train=True, rng=rng, dtype=cdt)
+            ctx = L.Context(train=True, rng=rng, dtype=cdt, matmul=mm)
 
             def loss_fn(params):
                 logits, is_cls = forward(params, ids, ctx)
@@ -219,7 +252,8 @@ class SequenceParallelEngine:
 
         def shard_eval(ts: TrainState, ids, labels):
             logits, is_cls = forward(
-                ts.params, ids, L.Context(train=False, dtype=cdt)
+                ts.params, ids,
+                L.Context(train=False, dtype=cdt, matmul=mm),
             )
             loss = cross_entropy(logits, labels) * is_cls
             m = _metrics(loss, logits, labels)
@@ -290,6 +324,9 @@ class CausalLMSequenceParallelEngine:
     donate: bool = True
     compute_dtype: Any = None
     remat: bool = False
+    # FFN pair as chunked ppermute rings over 'seq' (default off) — see
+    # SequenceParallelEngine.collective_matmul.
+    collective_matmul: bool = False
 
     def __post_init__(self):
         from distributed_model_parallel_tpu.models.gpt import (
@@ -315,6 +352,10 @@ class CausalLMSequenceParallelEngine:
         attn_fn = partial(
             ATTENTION[self.attention], axis_name="seq", causal=True
         )
+        self._matmul = _seq_matmul_policy(
+            self.collective_matmul, cfg.ffn_dim, mesh.shape["seq"]
+        )
+        mm = self._matmul
         self._repl = NamedSharding(mesh, P())
         self._batch = NamedSharding(mesh, P(("data",), ("seq",)))
         # Dense-parameter twin used ONLY for init (identical pytree).
@@ -366,7 +407,7 @@ class CausalLMSequenceParallelEngine:
                 ),
                 lax.axis_index("seq"),
             )
-            ctx = L.Context(train=True, rng=rng, dtype=cdt)
+            ctx = L.Context(train=True, rng=rng, dtype=cdt, matmul=mm)
 
             def loss_fn(params):
                 logits = forward(params, ids, ctx)
@@ -396,7 +437,8 @@ class CausalLMSequenceParallelEngine:
 
         def shard_eval(ts: TrainState, ids, targets):
             logits = forward(
-                ts.params, ids, L.Context(train=False, dtype=cdt)
+                ts.params, ids,
+                L.Context(train=False, dtype=cdt, matmul=mm),
             )
             m = local_sums(logits, targets)
             return {k: lax.psum(v, ("seq", "data")) for k, v in m.items()}

@@ -91,6 +91,16 @@ class TensorParallelEngine:
     donate: bool = True
     compute_dtype: Any = None  # see DataParallelEngine
     input_transform: Any = None  # see DataParallelEngine
+    # Latency-hiding collective matmul (default off): run the opted-in
+    # Megatron projections as chunked ppermute rings that overlap each
+    # ICI hop with the partial dot already on hand, instead of leaving
+    # the partitioner's monolithic collectives to the scheduler
+    # (`ops/collective_matmul.py`). Same math (parity pinned at rtol
+    # 1e-5 in tests/test_collective_matmul.py); between blocks the
+    # residual stream rides sequence-sharded over 'model' (Megatron-SP).
+    # Transformer-family models only: the policy reaches the qkv/out and
+    # ffn in/out projections through `Context.matmul` -> layers.project.
+    collective_matmul: bool = False
     # (remat lives at model construction — see DataParallelEngine note)
 
     def __post_init__(self):
@@ -113,6 +123,25 @@ class TensorParallelEngine:
             )
         self._repl = NamedSharding(mesh, P())
         self._batch = NamedSharding(mesh, P(("data",)))
+        self._matmul = None
+        if self.collective_matmul:
+            if "model" not in mesh.axis_names:
+                raise ValueError(
+                    "collective_matmul=True needs a 'model' mesh axis to "
+                    "ring over (the Megatron projection axis); this mesh "
+                    f"has {mesh.axis_names}"
+                )
+            from distributed_model_parallel_tpu.ops.collective_matmul import (
+                CollectiveMatmul,
+            )
+
+            self._matmul = CollectiveMatmul(
+                mesh=mesh, axis="model",
+                batch_axes=tuple(
+                    a for a in ("data",) if a in mesh.axis_names
+                ),
+            )
+        mm = self._matmul
         cdt = self.compute_dtype
         tf = self.input_transform
         model = self.model
@@ -126,7 +155,7 @@ class TensorParallelEngine:
             def loss_fn(params, model_state):
                 logits, new_state = model.apply(
                     params, model_state, inputs_c,
-                    Context(train=True, rng=rng, dtype=cdt),
+                    Context(train=True, rng=rng, dtype=cdt, matmul=mm),
                 )
                 ce = cross_entropy(logits, labels)
                 return ce + aux_loss(new_state), (new_state, logits, ce)
@@ -146,7 +175,7 @@ class TensorParallelEngine:
             )
             logits, _ = self.model.apply(
                 ts.params, ts.model_state, inputs_c,
-                Context(train=False, dtype=cdt),
+                Context(train=False, dtype=cdt, matmul=mm),
             )
             loss = cross_entropy(logits, labels)
             return _metrics(loss, logits, labels)

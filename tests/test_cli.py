@@ -50,6 +50,81 @@ def test_data_parallel_cli_fsdp(tmp_path, monkeypatch):
     assert len(result["history"]) == 1
 
 
+def test_data_parallel_cli_tp_collective_matmul(tmp_path, monkeypatch):
+    """--engine tp --collective-matmul drives the full entry point on a
+    (data, model) mesh with the chunked ppermute rings (a transformer
+    model; the flag reaches the projections via Context.matmul)."""
+    monkeypatch.chdir(tmp_path)
+    result = data_parallel.main([
+        "--engine", "tp", "--model-shards", "4",
+        "--collective-matmul",
+        "--model", "bert_tiny",
+        "-type", "SyntheticText",
+        "-b", "16", "--val-batch-size", "16",
+        "--epochs", "1", "--steps-per-epoch", "2",
+        "--lr", "0.05",
+    ])
+    assert len(result["history"]) == 1
+
+
+def test_collective_matmul_flag_guards():
+    """Default off everywhere; misuse fails loudly instead of silently
+    doing nothing: without --engine tp, without transformer projections,
+    and under lm.py's pipeline mode."""
+    from distributed_model_parallel_tpu.cli import lm
+
+    assert not data_parallel.build_parser().parse_args(
+        []
+    ).collective_matmul
+    assert not lm.build_parser().parse_args([]).collective_matmul
+    with pytest.raises(SystemExit):  # needs --engine tp
+        data_parallel.main([
+            "--collective-matmul", "--model", "bert_tiny",
+            "-type", "SyntheticText",
+        ])
+    with pytest.raises(SystemExit):  # no transformer projections
+        data_parallel.main([
+            "--engine", "tp", "--model-shards", "4",
+            "--collective-matmul", "--model", "tinycnn",
+            "-type", "Synthetic",
+        ])
+    with pytest.raises(SystemExit):  # plain tp on a CNN would silently
+        data_parallel.main([      # replicate every weight (no rules hit)
+            "--engine", "tp", "--model-shards", "4",
+            "--model", "tinycnn", "-type", "Synthetic",
+        ])
+    with pytest.raises(SystemExit):  # pipeline mode has no 'seq' rings
+        lm.main(["--pipeline-stages", "2", "--collective-matmul"])
+    with pytest.raises(SystemExit):  # --model-shards is tp-only
+        data_parallel.main([
+            "--model-shards", "4", "--model", "tinycnn",
+            "-type", "Synthetic",
+        ])
+    with pytest.raises(SystemExit):  # size-1 'seq' ring = silent no-op
+        lm.main(["--collective-matmul"])
+    with pytest.raises(SystemExit):  # size-1 'model' ring likewise
+        data_parallel.main([
+            "--engine", "tp", "--collective-matmul",
+            "--model", "bert_tiny", "-type", "SyntheticText",
+        ])
+
+
+def test_lm_cli_collective_matmul(tmp_path, monkeypatch):
+    """The lm CLI's --collective-matmul reaches the sequence-parallel
+    engine's FFN rings end-to-end."""
+    from distributed_model_parallel_tpu.cli import lm
+
+    monkeypatch.chdir(tmp_path)
+    result = lm.main([
+        "--seq-shards", "4", "--collective-matmul",
+        "--dim", "32", "--layers", "2", "--heads", "4",
+        "--ffn-dim", "64", "--seq-len", "32",
+        "-b", "8", "--epochs", "1", "--steps-per-epoch", "2",
+        "--corpus-tokens", "4096", "--lr", "1e-3",
+    ])
+    assert len(result["history"]) == 1
+
+
 def test_model_parallel_cli(tmp_path, monkeypatch):
     monkeypatch.chdir(tmp_path)
     result = model_parallel.main([

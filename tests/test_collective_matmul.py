@@ -1,0 +1,315 @@
+"""Latency-hiding collective matmul (`ops/collective_matmul.py`) parity
+tests on the 8-virtual-device CPU mesh.
+
+The chunked ppermute rings must be semantically invisible: `ag_matmul` /
+`matmul_rs` equal the monolithic all_gather/psum_scatter baselines
+(values AND custom-vjp gradients), and an engine constructed with
+`collective_matmul=True` must train bit-for-bit-close (rtol 1e-5) to its
+declarative twin — grads, metrics, and the multi-step trajectory — for
+every ring size the 8-device mesh can host: S in {2, 4, 8} (plus the
+odd-size single-ring path at S=3 for the raw ops).
+
+The structural side (S-1 collective-permutes, no monolithic
+all-gather/reduce-scatter on opted-in matmuls) is pinned from lowered
+HLO in tests/test_collectives_hlo.py.
+"""
+
+import dataclasses
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from distributed_model_parallel_tpu.models.bert import (
+    BertConfig,
+    bert_for_classification,
+)
+from distributed_model_parallel_tpu.ops.collective_matmul import (
+    ag_matmul,
+    matmul_rs,
+    naive_ag_matmul,
+    naive_matmul_rs,
+)
+from distributed_model_parallel_tpu.runtime.compat import shard_map
+from distributed_model_parallel_tpu.runtime.mesh import MeshSpec, make_mesh
+from distributed_model_parallel_tpu.training.optim import SGD
+
+from jax.sharding import Mesh, PartitionSpec as P
+
+# Heads divisible by every tested ring size (S=8 needs 8 heads); seq and
+# FFN widths divisible likewise.
+TINY = BertConfig(
+    vocab_size=61,
+    hidden_size=32,
+    num_layers=2,
+    num_heads=8,
+    intermediate_size=64,
+    max_position=16,
+    dropout_rate=0.0,  # deterministic parity
+)
+BATCH, SEQ, CLASSES = 8, 8, 4
+
+
+def _mesh_1d(size):
+    return Mesh(np.array(jax.devices()[:size]), ("m",))
+
+
+# ------------------------------------------------------------- raw ops
+
+
+@pytest.mark.parametrize("size", [2, 3, 4, 8])
+def test_ag_matmul_matches_monolithic_gather(size):
+    """Chunked == monolithic, bidirectional (even S) and single-ring
+    (odd S) alike; scale-realistic values keep fp32 reassociation noise
+    well under the engine parity bar."""
+    mesh = _mesh_1d(size)
+    rng = np.random.RandomState(0)
+    x = jnp.asarray(0.1 * rng.randn(2, 4 * size, 16), jnp.float32)
+    w = jnp.asarray(0.1 * rng.randn(16, 8 * size), jnp.float32)
+    specs = dict(
+        in_specs=(P(None, "m", None), P(None, "m")),
+        out_specs=P(None, None, "m"),
+        check_vma=False,
+    )
+    ring = jax.jit(shard_map(
+        partial(ag_matmul, axis_name="m"), mesh=mesh, **specs
+    ))
+    mono = jax.jit(shard_map(
+        partial(naive_ag_matmul, axis_name="m"), mesh=mesh, **specs
+    ))
+    np.testing.assert_allclose(ring(x, w), mono(x, w), rtol=1e-5,
+                               atol=1e-7)
+    np.testing.assert_allclose(ring(x, w), x @ w, rtol=1e-5, atol=1e-7)
+
+
+@pytest.mark.parametrize("size", [2, 3, 4, 8])
+def test_matmul_rs_matches_monolithic_scatter(size):
+    mesh = _mesh_1d(size)
+    rng = np.random.RandomState(1)
+    x = jnp.asarray(0.1 * rng.randn(2, 4 * size, 8 * size), jnp.float32)
+    w = jnp.asarray(0.1 * rng.randn(8 * size, 16), jnp.float32)
+    specs = dict(
+        in_specs=(P(None, None, "m"), P("m", None)),
+        out_specs=P(None, "m", None),
+        check_vma=False,
+    )
+    ring = jax.jit(shard_map(
+        partial(matmul_rs, axis_name="m"), mesh=mesh, **specs
+    ))
+    mono = jax.jit(shard_map(
+        partial(naive_matmul_rs, axis_name="m"), mesh=mesh, **specs
+    ))
+    np.testing.assert_allclose(ring(x, w), mono(x, w), rtol=1e-5,
+                               atol=1e-7)
+    np.testing.assert_allclose(ring(x, w), x @ w, rtol=1e-5, atol=1e-7)
+
+
+@pytest.mark.parametrize("size", [2, 3, 4, 8])
+def test_custom_vjp_matches_dense_gradients(size):
+    """Grads through the dual-kernel backward (ag_matmul <-> matmul_rs)
+    == jax.grad of the dense composition, for the column->row pair the
+    transformer blocks use."""
+    mesh = _mesh_1d(size)
+    rng = np.random.RandomState(2)
+    x = jnp.asarray(0.1 * rng.randn(2, 4 * size, 16), jnp.float32)
+    w1 = jnp.asarray(0.1 * rng.randn(16, 8 * size), jnp.float32)
+    w2 = jnp.asarray(0.1 * rng.randn(8 * size, 16), jnp.float32)
+
+    def ring_loss(x, w1, w2):
+        def f(xl, w1l, w2l):
+            h = jnp.tanh(ag_matmul(xl, w1l, "m"))
+            y = matmul_rs(h, w2l, "m")
+            # Per-shard partial sums, combined OUTSIDE shard_map (the
+            # engines' no-differentiated-psum discipline).
+            return jnp.sum(y * y)[None]
+
+        per = shard_map(
+            f, mesh=mesh,
+            in_specs=(P(None, "m", None), P(None, "m"), P("m", None)),
+            out_specs=P("m"), check_vma=False,
+        )
+        return jnp.sum(per(x, w1, w2))
+
+    def dense_loss(x, w1, w2):
+        y = jnp.tanh(x @ w1) @ w2
+        return jnp.sum(y * y)
+
+    g_ring = jax.jit(jax.grad(ring_loss, argnums=(0, 1, 2)))(x, w1, w2)
+    g_dense = jax.jit(jax.grad(dense_loss, argnums=(0, 1, 2)))(x, w1, w2)
+    for a, b in zip(g_ring, g_dense):
+        np.testing.assert_allclose(a, b, rtol=1e-5, atol=1e-6)
+
+
+# ------------------------------------------------- TP engine parity
+
+
+def _batch(seed=0, seq=SEQ):
+    rng = np.random.RandomState(seed)
+    ids = rng.randint(1, TINY.vocab_size, size=(BATCH, seq)).astype(
+        np.int32
+    )
+    ids[:, -2:] = 0  # pad tail -> exercises the attention mask
+    labels = rng.randint(0, CLASSES, size=(BATCH,)).astype(np.int32)
+    return ids, labels
+
+
+def _run(engine, ids, labels, n=3, lr=0.05):
+    ts = engine.init_state(jax.random.PRNGKey(0))
+    ids, labels = engine.shard_batch(ids, labels)
+    losses, accs = [], []
+    for _ in range(n):
+        ts, m = engine.train_step(ts, ids, labels, jnp.float32(lr))
+        losses.append(float(m["loss_sum"]) / float(m["count"]))
+        accs.append(float(m["correct1"]) / float(m["count"]))
+    return ts, losses, accs
+
+
+def _assert_state_close(ts_a, ts_b, rtol=1e-5, atol=1e-6):
+    flat_a = jax.tree_util.tree_leaves(ts_a.params)
+    flat_b = jax.tree_util.tree_leaves(ts_b.params)
+    for a, b in zip(flat_a, flat_b):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=rtol, atol=atol
+        )
+
+
+@pytest.mark.parametrize("tp", [2, 4, 8])
+def test_tp_collective_matmul_matches_declarative(tp):
+    """TensorParallelEngine(collective_matmul=True) == the declarative
+    engine: same per-step loss/acc metrics and the same parameters after
+    a 3-step trajectory, at every ring size the mesh hosts."""
+    from distributed_model_parallel_tpu.parallel.tensor_parallel import (
+        TensorParallelEngine,
+    )
+
+    mesh = make_mesh(MeshSpec(data=8 // tp, model=tp))
+    model = bert_for_classification(CLASSES, TINY)
+    ids, labels = _batch()
+    ts_d, loss_d, acc_d = _run(
+        TensorParallelEngine(model, SGD(), mesh, donate=False),
+        ids, labels,
+    )
+    ts_c, loss_c, acc_c = _run(
+        TensorParallelEngine(
+            model, SGD(), mesh, donate=False, collective_matmul=True
+        ),
+        ids, labels,
+    )
+    np.testing.assert_allclose(loss_c, loss_d, rtol=1e-5)
+    np.testing.assert_allclose(acc_c, acc_d, rtol=1e-5)
+    _assert_state_close(ts_c, ts_d)
+    assert loss_c[-1] < loss_c[0]
+
+
+def test_tp_collective_matmul_needs_model_axis():
+    from distributed_model_parallel_tpu.parallel.tensor_parallel import (
+        TensorParallelEngine,
+    )
+    from jax.sharding import Mesh
+
+    flat = Mesh(np.array(jax.devices()).reshape(8), ("data",))
+    with pytest.raises(ValueError, match="model"):
+        TensorParallelEngine(
+            bert_for_classification(CLASSES, TINY), SGD(), flat,
+            rules=(), collective_matmul=True,
+        )
+
+
+def test_tp_collective_matmul_rejects_indivisible_seq():
+    """T not divisible by the ring size must fail loudly at trace time,
+    not silently compute garbage chunks."""
+    from distributed_model_parallel_tpu.parallel.tensor_parallel import (
+        TensorParallelEngine,
+    )
+
+    mesh = make_mesh(MeshSpec(data=2, model=4))
+    eng = TensorParallelEngine(
+        bert_for_classification(CLASSES, TINY), SGD(), mesh,
+        donate=False, collective_matmul=True,
+    )
+    ts = eng.init_state(jax.random.PRNGKey(0))
+    ids, labels = _batch(seq=6)  # 6 % 4 != 0
+    ids, labels = eng.shard_batch(ids, labels)
+    with pytest.raises(ValueError, match="divisible"):
+        eng.train_step(ts, ids, labels, jnp.float32(0.05))
+
+
+# ------------------------------------------------- SP engine parity
+
+
+@pytest.mark.parametrize("sp", [2, 4, 8])
+def test_sp_collective_matmul_matches_ring_engine(sp):
+    """SequenceParallelEngine(collective_matmul=True) == the plain ring
+    engine (and therefore dense, by the existing SP parity pins):
+    metrics and trajectory at rtol 1e-5 for S in {2, 4, 8}."""
+    from distributed_model_parallel_tpu.parallel.sequence_parallel import (
+        SequenceParallelEngine,
+    )
+
+    mesh = make_mesh(MeshSpec(data=8 // sp, seq=sp))
+    ids, labels = _batch(seed=3, seq=16)
+    ts_r, loss_r, acc_r = _run(
+        SequenceParallelEngine(TINY, CLASSES, SGD(), mesh, donate=False),
+        ids, labels,
+    )
+    ts_c, loss_c, acc_c = _run(
+        SequenceParallelEngine(
+            TINY, CLASSES, SGD(), mesh, donate=False,
+            collective_matmul=True,
+        ),
+        ids, labels,
+    )
+    np.testing.assert_allclose(loss_c, loss_r, rtol=1e-5)
+    np.testing.assert_allclose(acc_c, acc_r, rtol=1e-5)
+    _assert_state_close(ts_c, ts_r)
+
+
+def test_lm_sp_collective_matmul_matches_ring_engine():
+    """The decoder-side twin: CausalLMSequenceParallelEngine with the
+    FFN rings matches its plain-ring self step for step."""
+    from distributed_model_parallel_tpu.models.gpt import GPTConfig
+    from distributed_model_parallel_tpu.parallel.sequence_parallel import (
+        CausalLMSequenceParallelEngine,
+    )
+
+    cfg = GPTConfig(
+        vocab_size=61, dim=32, num_layers=2, num_heads=4, ffn_dim=64,
+        max_position=16, dropout_rate=0.0, pad_token_id=0,
+    )
+    mesh = make_mesh(MeshSpec(data=2, seq=4))
+    rng = np.random.RandomState(5)
+    ids = rng.randint(1, 61, size=(BATCH, 16)).astype(np.int32)
+
+    def run(eng):
+        ts = eng.init_state(jax.random.PRNGKey(0))
+        a, b = eng.shard_batch(ids)
+        losses = []
+        for _ in range(3):
+            ts, m = eng.train_step(ts, a, b, jnp.float32(0.05))
+            losses.append(float(m["loss_sum"]) / float(m["count"]))
+        return ts, losses
+
+    ts_r, loss_r = run(CausalLMSequenceParallelEngine(
+        cfg, SGD(), mesh, donate=False
+    ))
+    ts_c, loss_c = run(CausalLMSequenceParallelEngine(
+        cfg, SGD(), mesh, donate=False, collective_matmul=True
+    ))
+    np.testing.assert_allclose(loss_c, loss_r, rtol=1e-5)
+    _assert_state_close(ts_c, ts_r)
+    assert loss_c[-1] < loss_c[0]
+
+
+def test_sp_collective_matmul_rejects_indivisible_ffn():
+    from distributed_model_parallel_tpu.parallel.sequence_parallel import (
+        SequenceParallelEngine,
+    )
+
+    mesh = make_mesh(MeshSpec(data=2, seq=4))
+    cfg = dataclasses.replace(TINY, intermediate_size=66)  # 66 % 4 != 0
+    with pytest.raises(ValueError, match="divisible"):
+        SequenceParallelEngine(
+            cfg, CLASSES, SGD(), mesh, collective_matmul=True
+        )

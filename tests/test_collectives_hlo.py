@@ -4,6 +4,9 @@ refactor can't silently drop an all-reduce (numerics tests would catch
 the wrong RESULT, but only on multi-sample tolerance; this pins the
 mechanism)."""
 
+import re
+from functools import partial
+
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -16,6 +19,40 @@ from distributed_model_parallel_tpu.training.optim import SGD
 
 def _hlo(engine, *args):
     return engine.train_step.lower(*args).compile().as_text()
+
+
+# A collective op's result type: a plain shape token on sync backends
+# (`= f32[8,16]{1,0} all-gather(`) or a parenthesized tuple on async
+# ones (`= (f32[...], f32[...]) all-gather-start(`).
+_RESULT = r"(?:\([^)\n]*\)|\S+)"
+
+
+def _collective_counts(hlo: str) -> dict:
+    """Occurrences of each collective OP (not operand mentions) in
+    compiled HLO text; async backends emit `<op>-start`/`-done` pairs,
+    counted once via the -start form."""
+
+    def n(op):
+        return len(re.findall(rf"= {_RESULT} {op}(?:-start)?\(", hlo))
+
+    return {
+        "collective-permute": n("collective-permute"),
+        "all-gather": n("all-gather"),
+        "reduce-scatter": n("reduce-scatter"),
+        "all-reduce": n("all-reduce"),
+        "all-to-all": n("all-to-all"),
+    }
+
+
+def _has_op_with_result(hlo: str, op: str, shape: str) -> bool:
+    """True when an `op` whose RESULT carries `shape` exists — matched
+    on the op's definition line (sync or async-start form), never on
+    operand mentions."""
+    pat = (
+        rf"= (?:\([^)\n]*{re.escape(shape)}[^)\n]*\)|{re.escape(shape)}"
+        rf"\S*) {op}(?:-start)?\("
+    )
+    return re.search(pat, hlo) is not None
 
 
 def _batch(n, hw=8, classes=4, seed=0):
@@ -120,6 +157,231 @@ def test_sp_ring_step_contains_permute_chain():
     hlo = _hlo(eng, ts, ids, lb, jnp.float32(0.1))
     assert "collective-permute" in hlo   # the KV ring
     assert "all-reduce" in hlo           # grad psum('seq')+pmean('data')
+
+
+# ------------------------------------------------ collective matmul
+# The latency-hiding chunked rings (`ops/collective_matmul.py`): an
+# opted-in matmul must lower to the S-1 `collective-permute` chain with
+# NO monolithic all-gather / reduce-scatter left on it, forward and
+# backward both (the custom-vjp dual kernels are themselves chunked).
+
+
+@pytest.mark.parametrize("size", [2, 4, 8])
+def test_ag_matmul_lowers_to_s_minus_1_permutes(size):
+    from jax.sharding import Mesh, PartitionSpec as P
+
+    from distributed_model_parallel_tpu.ops.collective_matmul import (
+        ag_matmul,
+    )
+    from distributed_model_parallel_tpu.runtime.compat import shard_map
+
+    mesh = Mesh(np.array(jax.devices()[:size]), ("m",))
+    x = jnp.zeros((2, 4 * size, 16), jnp.float32)
+    w = jnp.zeros((16, 8 * size), jnp.float32)
+    fn = jax.jit(shard_map(
+        partial(ag_matmul, axis_name="m"), mesh=mesh,
+        in_specs=(P(None, "m", None), P(None, "m")),
+        out_specs=P(None, None, "m"), check_vma=False,
+    ))
+    c = _collective_counts(fn.lower(x, w).compile().as_text())
+    assert c["collective-permute"] == size - 1
+    assert c["all-gather"] == 0 and c["reduce-scatter"] == 0
+    assert c["all-reduce"] == 0
+
+
+@pytest.mark.parametrize("size", [2, 4, 8])
+def test_matmul_rs_lowers_to_s_minus_1_permutes(size):
+    from jax.sharding import Mesh, PartitionSpec as P
+
+    from distributed_model_parallel_tpu.ops.collective_matmul import (
+        matmul_rs,
+    )
+    from distributed_model_parallel_tpu.runtime.compat import shard_map
+
+    mesh = Mesh(np.array(jax.devices()[:size]), ("m",))
+    x = jnp.zeros((2, 4 * size, 8 * size), jnp.float32)
+    w = jnp.zeros((8 * size, 16), jnp.float32)
+    fn = jax.jit(shard_map(
+        partial(matmul_rs, axis_name="m"), mesh=mesh,
+        in_specs=(P(None, None, "m"), P("m", None)),
+        out_specs=P(None, "m", None), check_vma=False,
+    ))
+    c = _collective_counts(fn.lower(x, w).compile().as_text())
+    assert c["collective-permute"] == size - 1
+    assert c["all-gather"] == 0 and c["reduce-scatter"] == 0
+    assert c["all-reduce"] == 0
+
+
+def test_collective_matmul_ffn_pair_forward_and_backward_chunked():
+    """The column->row FFN pair through the jit-level policy: forward is
+    exactly 2(S-1) permutes; jax.grad through the custom vjps is the
+    dual-kernel 5(S-1) total (fwd 2 + ag-bwd 2 + rs-bwd 1 rings) — and
+    neither direction contains a monolithic all-gather/reduce-scatter."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from distributed_model_parallel_tpu.ops.collective_matmul import (
+        CollectiveMatmul,
+    )
+
+    size = 4
+    mesh = make_mesh(MeshSpec(data=2, model=size))
+    policy = CollectiveMatmul(mesh=mesh, axis="model")
+    hs = NamedSharding(mesh, P("data", None, None))
+    h = jnp.zeros((8, 8, 32), jnp.float32)
+    w1, b1 = jnp.zeros((32, 64)), jnp.zeros((64,))
+    w2, b2 = jnp.zeros((64, 32)), jnp.zeros((32,))
+
+    def pair(h, w1, b1, w2, b2):
+        y = jax.nn.gelu(policy.column(h, w1, b1), approximate=False)
+        return policy.row(y, w2, b2)
+
+    out_s = NamedSharding(mesh, P("data", "model", None))
+    fwd = jax.jit(pair, in_shardings=(hs, None, None, None, None),
+                  out_shardings=out_s)
+    c = _collective_counts(
+        fwd.lower(h, w1, b1, w2, b2).compile().as_text()
+    )
+    assert c["collective-permute"] == 2 * (size - 1)
+    assert c["all-gather"] == 0 and c["reduce-scatter"] == 0
+    assert c["all-reduce"] == 0
+
+    grad = jax.jit(
+        jax.grad(
+            lambda *a: jnp.sum(pair(*a) ** 2), argnums=(0, 1, 2, 3, 4)
+        ),
+        in_shardings=(hs, None, None, None, None),
+    )
+    cg = _collective_counts(
+        grad.lower(h, w1, b1, w2, b2).compile().as_text()
+    )
+    assert cg["collective-permute"] == 5 * (size - 1)
+    assert cg["all-gather"] == 0 and cg["reduce-scatter"] == 0
+
+
+def test_collective_matmul_block_has_no_monolithic_collectives():
+    """A full encoder block under the policy: all four opted-in
+    projections ring (>= 4(S-1) permutes — the partitioner may add its
+    own resharding permutes) and the block forward contains NO
+    all-gather / reduce-scatter / all-reduce at all."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from distributed_model_parallel_tpu.models import layers as L
+    from distributed_model_parallel_tpu.models.transformer import (
+        encoder_layer,
+    )
+    from distributed_model_parallel_tpu.ops.collective_matmul import (
+        CollectiveMatmul,
+    )
+
+    size = 4
+    mesh = make_mesh(MeshSpec(data=2, model=size))
+    policy = CollectiveMatmul(mesh=mesh, axis="model")
+    blk = encoder_layer(32, 4, 64, dropout_rate=0.0)
+    params, _ = blk.init(jax.random.PRNGKey(0))
+    ctx = L.Context(train=False, matmul=policy)
+    h = jnp.zeros((8, 8, 32), jnp.float32)
+    mask = jnp.ones((8, 8), bool)
+    hs = NamedSharding(mesh, P("data", None, None))
+    out_s = NamedSharding(mesh, P("data", "model", None))
+
+    fwd = jax.jit(
+        lambda p, h, m: blk.apply(p, {}, (h, m), ctx)[0][0],
+        in_shardings=(None, hs, None), out_shardings=out_s,
+    )
+    c = _collective_counts(fwd.lower(params, h, mask).compile().as_text())
+    assert c["collective-permute"] >= 4 * (size - 1)
+    assert c["all-gather"] == 0 and c["reduce-scatter"] == 0
+    assert c["all-reduce"] == 0
+
+
+def test_tp_collective_matmul_step_swaps_gathers_for_permutes():
+    """Engine level: turning collective_matmul on must multiply the
+    permute count (the rings) and strictly shrink the all-gather count
+    (the monolithic collectives it replaces) in the SAME train step."""
+    from distributed_model_parallel_tpu.models.bert import (
+        BertConfig,
+        bert_for_classification,
+    )
+    from distributed_model_parallel_tpu.parallel.tensor_parallel import (
+        TensorParallelEngine,
+    )
+
+    cfg = BertConfig(vocab_size=64, hidden_size=32, num_layers=1,
+                     num_heads=4, intermediate_size=64, max_position=8,
+                     dropout_rate=0.0)
+    mesh = make_mesh(MeshSpec(data=2, model=4))
+    model = bert_for_classification(4, cfg)
+    rng = np.random.RandomState(0)
+    ids = rng.randint(1, 64, size=(8, 8)).astype(np.int32)
+    lb = rng.randint(0, 4, size=(8,)).astype(np.int32)
+    counts = {}
+    for cm in (False, True):
+        eng = TensorParallelEngine(
+            model, SGD(), mesh, donate=False, collective_matmul=cm
+        )
+        ts = eng.init_state(jax.random.PRNGKey(0))
+        a, b = eng.shard_batch(ids, lb)
+        counts[cm] = _collective_counts(
+            _hlo(eng, ts, a, b, jnp.float32(0.1))
+        )
+    # 1 block = 4 ring sites; fwd+bwd >= 10(S-1) = 30 ring permutes.
+    assert (counts[True]["collective-permute"]
+            >= counts[False]["collective-permute"] + 30)
+    assert counts[True]["all-gather"] < counts[False]["all-gather"]
+
+
+# ------------------------------------------------------------- FSDP
+# ZeRO-3's two collectives, pinned from the lowered step: the forward
+# all-gathers each sharded weight before use, and the backward
+# REDUCE-SCATTERS each sharded leaf's gradient (never a plain
+# all-reduce handing every device the full gradient).
+
+
+def test_fsdp_step_gathers_weights_and_reduce_scatters_grads():
+    """Structural FSDP collective story on a pure-matmul MLP.
+
+    Shapes put the step in the ZeRO regime (batch rows >> hidden dim):
+    the partitioner must choose weight-stationary-sharded lowering —
+    all-gather each weight before its matmul, scatter each gradient —
+    rather than gathering the (here larger) activations.
+
+    The backward assertion accepts the two spellings of reduce-scatter:
+    the fused `reduce-scatter` op (TPU/GPU pipelines), or the SPMD
+    partitioner's unfused pair — an all-reduce of the full-size f32
+    gradient immediately dynamic-sliced to this device's 1/N shard —
+    which is what the CPU pipeline emits (its ReduceScatterCreator pass
+    doesn't run there). Both are pinned by shape for the (128,128)
+    leaf: the full gradient must be reduced AND a 1/8 shard sliced out
+    of it; a refactor that hands every device a full REPLICATED
+    gradient (plain DDP all-reduce, no scatter) fails the slice pin."""
+    from distributed_model_parallel_tpu.models import layers as L
+    from distributed_model_parallel_tpu.parallel.fsdp import FSDPEngine
+
+    mesh = make_mesh(MeshSpec(data=8))
+    model = L.sequential(
+        L.flatten(),                 # (B, 8, 8, 3) -> (B, 192)
+        L.linear(192, 128),
+        L.relu(),
+        L.linear(128, 128),
+        L.relu(),
+        L.linear(128, 4),
+    )
+    eng = FSDPEngine(model, SGD(), mesh, donate=False)
+    ts = eng.init_state(jax.random.PRNGKey(0))
+    im, lb = eng.shard_batch(*_batch(1024))
+    hlo = _hlo(eng, ts, im, lb, jnp.float32(0.1))
+
+    # Forward: the (128,128) weight is all-gathered from its (16,128)
+    # 'data' shards right before its matmul.
+    assert _has_op_with_result(hlo, "all-gather", "f32[128,128]")
+
+    if "reduce-scatter" not in hlo:
+        # Unfused reduce-scatter: full-size gradient all-reduce ...
+        assert _has_op_with_result(hlo, "all-reduce", "f32[128,128]")
+        # ... immediately scattered: a 1/8 dynamic-slice of the reduced
+        # gradient (shape-pinned to the (128,128) leaf's shard).
+        assert ("dynamic_slice_sizes={16,128}" in hlo
+                or "dynamic_slice_sizes={128,16}" in hlo)
 
 
 def test_sp_ulysses_step_contains_all_to_all():

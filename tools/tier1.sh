@@ -1,0 +1,32 @@
+#!/usr/bin/env bash
+# Tier-1 verify — THE canonical test command (ROADMAP.md "Tier-1
+# verify"). Checked in so builder and reviewer run the same line instead
+# of copy-pasting divergent variants.
+#
+#   bash tools/tier1.sh            # from the repo root
+#
+# Behavior, kept bit-identical to the ROADMAP line:
+#   * CPU-only jax (the conftest also forces it; the env var keeps the
+#     PJRT plugin from dialing the TPU relay at interpreter start),
+#   * the default marker filter (-m 'not slow', see pytest.ini),
+#   * survives collection errors so one broken module can't hide the
+#     rest of the suite's result,
+#   * 870 s budget with a hard kill 10 s later,
+#   * DOTS_PASSED=<n> printed from the progress dots as a
+#     tamper-resistant pass count (parsed from the tee'd log, not from
+#     pytest's summary line),
+#   * exits with pytest's status (PIPESTATUS survives the tee).
+
+set -o pipefail
+cd "$(dirname "$0")/.."
+
+rm -f /tmp/_t1.log
+timeout -k 10 870 env JAX_PLATFORMS=cpu \
+    python -m pytest tests/ -q -m 'not slow' \
+    --continue-on-collection-errors \
+    -p no:cacheprovider -p no:xdist -p no:randomly \
+    2>&1 | tee /tmp/_t1.log
+rc=${PIPESTATUS[0]}
+echo DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' /tmp/_t1.log \
+    | tr -cd . | wc -c)
+exit $rc
