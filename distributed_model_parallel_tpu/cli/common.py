@@ -82,6 +82,10 @@ TRANSFORMER_MODELS = ("bert", "bert_tiny", "vit")
 
 # Pipeline stage builders, kept beside MODELS so both CLIs extend in one
 # place: name -> fn(num_stages, num_classes, boundaries) -> [Layer].
+# `num_stages` counts CHUNKS: an interleaved virtual pipeline
+# (--pipeline-schedule interleaved --virtual-stages V) passes S·V here,
+# and the engine deals the chunks round-robin to the S devices
+# (models/staging.py `chunk_owner`).
 STAGE_BUILDERS = {
     "mobilenetv2": lambda n, c, b: mobilenetv2.split_stages(
         n, c, boundaries=b
@@ -270,6 +274,45 @@ def check_batch_divisibility(
             f"{label} size {global_batch} gives {local} samples per 'data' "
             f"shard, not divisible by --microbatches {microbatches}"
         )
+
+
+def check_pipeline_schedule_args(
+    schedule: str, virtual_stages: int, microbatches: int, num_stages: int
+) -> None:
+    """Startup-time validation of the (schedule, V, M, S) surface shared
+    by both pipeline CLIs — fail before loaders/meshes are built, with
+    CLI-flag vocabulary, instead of at engine construction:
+
+    * --virtual-stages is an interleaved-only knob (gpipe/1f1b run one
+      chunk per device; a silent no-op flag would mislabel the run);
+    * interleaving needs >= 2 physical stages (one device has no bubble
+      to divide);
+    * V > 1 needs --microbatches divisible by the stage count
+      (Megatron's round-robin microbatch groups — the schedule builder
+      enforces the same)."""
+    if virtual_stages < 1:
+        raise SystemExit(
+            f"--virtual-stages must be >= 1, got {virtual_stages}"
+        )
+    if virtual_stages > 1 and schedule != "interleaved":
+        raise SystemExit(
+            "--virtual-stages > 1 requires --pipeline-schedule "
+            "interleaved (gpipe/1f1b run exactly one model chunk per "
+            "device, so the flag would silently do nothing)"
+        )
+    if schedule == "interleaved":
+        if num_stages < 2:
+            raise SystemExit(
+                "--pipeline-schedule interleaved needs >= 2 pipeline "
+                "stages (a one-device pipeline has no bubble to divide)"
+            )
+        if virtual_stages > 1 and microbatches % num_stages:
+            raise SystemExit(
+                f"interleaved schedule needs --microbatches divisible "
+                f"by the stage count (got M={microbatches}, "
+                f"S={num_stages}) — Megatron's round-robin microbatch "
+                f"groups"
+            )
 
 
 def compute_dtype_from_flag(name: str):

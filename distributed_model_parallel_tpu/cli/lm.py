@@ -30,6 +30,7 @@ import jax
 from distributed_model_parallel_tpu.cli.common import (
     build_optimizer,
     check_batch_divisibility,
+    check_pipeline_schedule_args,
     compute_dtype_from_flag,
 )
 from distributed_model_parallel_tpu.data.lm import (
@@ -80,10 +81,19 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--microbatches", default=1, type=int,
                    help="pipeline microbatches (pipeline mode)")
     p.add_argument("--pipeline-schedule", default="gpipe",
-                   choices=("gpipe", "1f1b"),
+                   choices=("gpipe", "1f1b", "interleaved"),
                    help="pipeline schedule (pipeline mode): gpipe = "
                         "fill-drain, O(M) live activations; 1f1b = "
-                        "PipeDream-flush, O(S) — same trajectory")
+                        "PipeDream-flush, O(S) — same trajectory; "
+                        "interleaved = Megatron virtual pipeline (pair "
+                        "with --virtual-stages V) — same trajectory, "
+                        "bubble floor divided by V")
+    p.add_argument("--virtual-stages", default=1, type=int,
+                   help="decoder-block chunks per pipeline stage "
+                        "(interleaved schedule): the model splits into "
+                        "--pipeline-stages x V chunks dealt round-robin "
+                        "to devices; needs --microbatches divisible by "
+                        "--pipeline-stages and --layers >= S*V")
     p.add_argument("--attention", default="ring",
                    choices=("ring", "ring_flash", "ulysses",
                             "ulysses_flash"),
@@ -149,15 +159,27 @@ def main(argv=None) -> dict:
             "--pipeline-schedule selects the pipeline engine's tick "
             "program; it has no effect without --pipeline-stages > 1"
         )
+    if args.pipeline_stages <= 1 and args.virtual_stages != 1:
+        raise SystemExit(
+            "--virtual-stages is an interleaved-pipeline knob; it has "
+            "no effect without --pipeline-stages > 1"
+        )
     if args.microbatches < 1:
         raise SystemExit(
             f"--microbatches must be >= 1, got {args.microbatches}"
         )
-    if args.pipeline_stages > 1 and args.pipeline_stages > args.layers:
+    if args.pipeline_stages > 1:
+        check_pipeline_schedule_args(
+            args.pipeline_schedule, args.virtual_stages,
+            args.microbatches, args.pipeline_stages,
+        )
+    num_chunks = args.pipeline_stages * args.virtual_stages
+    if args.pipeline_stages > 1 and num_chunks > args.layers:
         raise SystemExit(
-            f"--pipeline-stages {args.pipeline_stages} exceeds "
-            f"--layers {args.layers}: a stage needs at least one "
-            f"decoder block"
+            f"--pipeline-stages {args.pipeline_stages} x "
+            f"--virtual-stages {args.virtual_stages} = {num_chunks} "
+            f"chunks exceeds --layers {args.layers}: a chunk needs at "
+            f"least one decoder block"
         )
     if args.pipeline_stages > 1:
         mesh = make_mesh(MeshSpec(data=-1, stage=args.pipeline_stages))
@@ -189,13 +211,14 @@ def main(argv=None) -> dict:
         )
 
         engine = LMPipelineEngine(
-            split_stages(args.pipeline_stages, cfg),
+            split_stages(num_chunks, cfg),
             build_optimizer(args),
             mesh,
             num_microbatches=args.microbatches,
             compute_dtype=compute_dtype_from_flag(args.dtype),
             remat=args.remat,
             schedule=args.pipeline_schedule,
+            virtual_stages=args.virtual_stages,
             pad_token_id=cfg.pad_token_id,
         )
     else:

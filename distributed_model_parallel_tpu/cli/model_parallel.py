@@ -32,6 +32,7 @@ from distributed_model_parallel_tpu.cli.common import (
     build_loaders,
     build_optimizer,
     check_batch_divisibility,
+    check_pipeline_schedule_args,
     compute_dtype_from_flag,
 )
 from distributed_model_parallel_tpu.parallel.pipeline import PipelineEngine
@@ -75,13 +76,24 @@ def build_parser() -> argparse.ArgumentParser:
                         help="pipeline microbatches in flight; 1 = the "
                              "reference's single-batch schedule")
     parser.add_argument("--pipeline-schedule", default="gpipe",
-                        choices=("gpipe", "1f1b"),
+                        choices=("gpipe", "1f1b", "interleaved"),
                         help="gpipe = fill-drain (O(M) live activations); "
                              "1f1b = one-forward-one-backward "
                              "(PipeDream-flush), same trajectory with "
                              "O(S) live activations — lets "
                              "--microbatches scale until the bubble is "
-                             "negligible")
+                             "negligible; interleaved = Megatron's "
+                             "virtual pipeline (pair with "
+                             "--virtual-stages V): same trajectory with "
+                             "the bubble floor divided by V")
+    parser.add_argument("--virtual-stages", default=1, type=int,
+                        help="model chunks per pipeline stage "
+                             "(interleaved schedule): the model splits "
+                             "into world-size x V chunks and device s "
+                             "owns chunks s, s+S, ... — bubble fraction "
+                             "drops from (S-1)/(M+S-1) to "
+                             "(S-1)/(V*M+S-1); needs --microbatches "
+                             "divisible by --world-size")
     parser.add_argument("--reference-split", action="store_true",
                         help="use the reference's exact ws=4 stage "
                              "boundaries [3, 9, 15] (requires "
@@ -95,9 +107,18 @@ def build_parser() -> argparse.ArgumentParser:
 
 
 def build_stages(model: str, num_stages: int, num_classes: int,
-                 reference_split: bool):
+                 reference_split: bool, virtual_stages: int = 1):
+    """[Layer] chunks for the pipeline engine: `num_stages` devices ×
+    `virtual_stages` chunks each (the interleaved schedule's S·V split;
+    V=1 is the classic one-stage-per-device partition)."""
     boundaries = None
     if reference_split:
+        if virtual_stages != 1:
+            raise SystemExit(
+                "--reference-split fixes the ws=4 one-chunk-per-rank "
+                "boundaries [3, 9, 15]; it cannot be combined with "
+                "--virtual-stages > 1 (which needs a 4*V-way split)"
+            )
         if num_stages != 4 or not model.startswith("mobilenetv2"):
             raise SystemExit(
                 "--reference-split needs --world-size 4 and MobileNetV2"
@@ -109,11 +130,26 @@ def build_stages(model: str, num_stages: int, num_classes: int,
             f"pipeline-splittable models: {sorted(STAGE_BUILDERS)}. "
             f"(Every model trains under the data-parallel CLI.)"
         )
-    return STAGE_BUILDERS[model](num_stages, num_classes, boundaries)
+    try:
+        return STAGE_BUILDERS[model](
+            num_stages * virtual_stages, num_classes, boundaries
+        )
+    except ValueError as e:
+        # split_points rejects more chunks than blocks — surface it in
+        # CLI-flag vocabulary.
+        raise SystemExit(
+            f"model {model!r} cannot split into "
+            f"{num_stages * virtual_stages} chunks (--world-size "
+            f"{num_stages} x --virtual-stages {virtual_stages}): {e}"
+        )
 
 
 def main(argv=None) -> dict:
     args = build_parser().parse_args(argv)
+    check_pipeline_schedule_args(
+        args.pipeline_schedule, args.virtual_stages, args.microbatches,
+        args.world_size,
+    )
     initialize_backend(coordinator_address=args.dist_url)
     mesh = make_mesh(MeshSpec(data=-1, stage=args.world_size))
     check_batch_divisibility(
@@ -124,7 +160,8 @@ def main(argv=None) -> dict:
         workers=args.workers,
     )
     stages = build_stages(
-        args.model, args.world_size, num_classes, args.reference_split
+        args.model, args.world_size, num_classes, args.reference_split,
+        args.virtual_stages,
     )
     engine = PipelineEngine(
         stages,
@@ -135,6 +172,7 @@ def main(argv=None) -> dict:
         stage_local_params=args.stage_local_params,
         remat=args.remat,
         schedule=args.pipeline_schedule,
+        virtual_stages=args.virtual_stages,
     )
     cfg = TrainerConfig(
         epochs=args.epochs,

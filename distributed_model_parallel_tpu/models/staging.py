@@ -18,6 +18,35 @@ import jax
 from distributed_model_parallel_tpu.models import layers as L
 
 
+def chunk_owner(logical: int, num_stages: int) -> int:
+    """Physical stage that owns logical pipeline chunk `logical` under
+    the interleaved virtual-pipeline placement (Megatron SC'21): chunks
+    are dealt round-robin, so device s owns logicals {s, s+S, s+2S, ...}
+    — NON-contiguous slices of the model, which is what lets a
+    microbatch revisit every device V times and divide the pipeline
+    bubble by V. With V=1 this is the identity (chunk i on device i)."""
+    return logical % num_stages
+
+
+def row_of_logical(logical: int, num_stages: int,
+                   virtual_stages: int) -> int:
+    """Storage row of logical chunk `logical` in the stage-local packed
+    (S·V, maxP) parameter array. Rows are DEVICE-MAJOR — row s·V + v
+    holds device s's v-th chunk (logical v·S + s) — so sharding the
+    leading axis P('stage') lands each device's V chunks on it in local
+    rows 0..V-1, matching the in-step chunk index."""
+    s = logical % num_stages
+    v = logical // num_stages
+    return s * virtual_stages + v
+
+
+def logical_of_row(row: int, num_stages: int, virtual_stages: int) -> int:
+    """Inverse of `row_of_logical`."""
+    s = row // virtual_stages
+    v = row % virtual_stages
+    return v * num_stages + s
+
+
 def split_points(num_stages: int, boundaries: Sequence[int] | None,
                  n_blocks: int) -> List[int]:
     """Cut points [0, ..., n_blocks] delimiting each stage's block range.
@@ -25,6 +54,10 @@ def split_points(num_stages: int, boundaries: Sequence[int] | None,
     Default: blocks distributed as evenly as possible (earlier stages get
     the remainder). Pass `boundaries` (len num_stages-1) to override —
     e.g. [3, 9, 15] reproduces the reference's ws=4 MobileNetV2 split.
+    `num_stages` counts CHUNKS: an interleaved virtual pipeline over S
+    devices with V chunks each passes S·V here (the assembly convention
+    is unchanged — stem on chunk 0, head on the last chunk; the ENGINE
+    deals chunks round-robin to devices, `chunk_owner`).
     """
     if num_stages < 1 or num_stages > n_blocks:
         raise ValueError(f"num_stages must be in [1,{n_blocks}]")

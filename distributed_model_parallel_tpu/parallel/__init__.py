@@ -7,6 +7,7 @@ from distributed_model_parallel_tpu.parallel.pipeline import (  # noqa: F401
     LMPipelineEngine,
     PipelineEngine,
     build_1f1b_schedule,
+    build_interleaved_schedule,
 )
 from distributed_model_parallel_tpu.parallel.sequence_parallel import (  # noqa: F401
     CausalLMSequenceParallelEngine,

@@ -28,13 +28,18 @@ but one idle (`Readme.md:283-292`: MP is      `num_microbatches` M: scan over
                                               M=1 reproduces the reference's
                                               single-batch schedule exactly
 
-Two schedules (INTERNALS.md §3b): `schedule="gpipe"` (above — backward is
-autodiff through the tick scan, O(M) live activations per stage) and
-`schedule="1f1b"` (PipeDream-flush — a hand-scheduled forward+backward
-tick program from `build_1f1b_schedule`, per-stage activation stash
-bounded by a min(S, M)-deep ring, so M scales until the bubble is
-negligible at O(S) memory). Gradients/trajectories are identical
-(tests/test_pipeline_schedule.py).
+Three schedules (INTERNALS.md §3b/§3d): `schedule="gpipe"` (above —
+backward is autodiff through the tick scan, O(M) live activations per
+stage), `schedule="1f1b"` (PipeDream-flush — a hand-scheduled
+forward+backward tick program from `build_1f1b_schedule`, per-stage
+activation stash bounded by a min(S, M)-deep ring, so M scales until
+the bubble is negligible at O(S) memory), and
+`schedule="interleaved"` (Megatron's interleaved virtual pipeline,
+Narayanan et al. SC'21 — each device owns `virtual_stages=V`
+NON-contiguous model chunks, activations ring-route S·V-1 logical hops
+over S physical devices, and the bubble floor drops from
+(S-1)/(M+S-1) to (S-1)/(V·M+S-1)). Gradients/trajectories are
+identical across all three (tests/test_pipeline_schedule.py).
 
 Combinable with data parallelism: a (data=D, stage=S) mesh runs D
 independent pipelines, gradients pmean over 'data' and psum over 'stage'
@@ -73,7 +78,12 @@ from distributed_model_parallel_tpu.runtime.compat import shard_map
 
 from distributed_model_parallel_tpu.models.layers import Context, Layer
 from distributed_model_parallel_tpu.models.layers import remat as remat_layer
-from distributed_model_parallel_tpu.models.staging import stage_io_avals
+from distributed_model_parallel_tpu.models.staging import (
+    chunk_owner,
+    logical_of_row,
+    row_of_logical,
+    stage_io_avals,
+)
 from distributed_model_parallel_tpu.parallel.data_parallel import (
     TrainState,
     _cast_input,
@@ -326,6 +336,214 @@ def build_1f1b_schedule(num_stages: int, num_microbatches: int) -> Schedule1F1B:
     )
 
 
+# ---------------------------------------------------------------------------
+# Interleaved virtual-pipeline tick schedule (Megatron SC'21) — the (T, S, V)
+# generalization of the 1F1B tables. V=1 reduces EXACTLY to
+# `build_1f1b_schedule` (pinned by tests/test_pipeline_schedule.py).
+# ---------------------------------------------------------------------------
+
+
+class ScheduleTicks(NamedTuple):
+    """Static tick tables generalized over `virtual_stages` V, all shaped
+    (T, S). Each physical stage owns V model chunks; `chunk[t, s]` names
+    which of device s's chunks runs at tick t (the logical pipeline stage
+    is `chunk * S + s`, so device s owns logical stages {s, s+S, ...} —
+    Megatron's round-robin chunk placement). The recv tables gain a
+    chunk column: the activation (up-ring) / cotangent (down-ring) wire
+    payload a device holds at the START of tick t belongs to ring slot
+    `recv_*_c * depth + recv_*_m % depth`. Ring depths are PER-CHUNK:
+    the stash array is (V * stash_depth, buf)."""
+
+    work: np.ndarray
+    micro: np.ndarray
+    chunk: np.ndarray
+    recv_fwd: np.ndarray
+    recv_fwd_m: np.ndarray
+    recv_fwd_c: np.ndarray
+    recv_bwd: np.ndarray
+    recv_bwd_m: np.ndarray
+    recv_bwd_c: np.ndarray
+    num_ticks: int
+    stash_depth: int
+    cot_depth: int
+    num_virtual: int
+
+
+def build_interleaved_schedule(
+    num_stages: int, num_microbatches: int, virtual_stages: int = 1
+) -> ScheduleTicks:
+    """Interleaved 1F1B tick program over S devices × V chunks each.
+
+    Work order per device is Megatron's (Narayanan et al., SC'21,
+    `megatron/core/pipeline_parallel/schedules.py`): microbatches are
+    processed in groups of S — forward k runs chunk (k//S) % V on
+    microbatch (k//(S·V))·S + k%S, backwards mirror with the chunk
+    order reversed — with warmup 2(S-1-s) + (V-1)·S forwards before the
+    first backward (V=1 keeps the non-interleaved min(S-1-s, M), which
+    makes the V=1 tables bit-identical to `build_1f1b_schedule`). Ticks
+    are assigned by the same greedy lockstep simulation: dependencies
+    are between LOGICAL stages l = v·S + s (one ring-ppermute hop, so a
+    consumer runs strictly after its producer's tick).
+
+    The payoff is the span: T = 2MV + 2(S-1) chunk-ticks for 2MV
+    chunk-ticks of work per device, i.e. an idle fraction of
+    (S-1)/(V·M+S-1) — the 1F1B bubble divided by V (each chunk-tick is
+    1/V of a stage-tick of compute, so the fill/drain cost shrinks by V
+    while total compute is unchanged). The price is stash memory: early
+    chunks' activations live until their late backwards, so the
+    per-chunk ring depth grows past min(S, M) (bounded below by the
+    exact live intervals, asserted <= min(M, 2S) here) and there are V
+    rings. Megatron requires M % S == 0 for V > 1; so do we.
+    """
+    S, M, V = num_stages, num_microbatches, virtual_stages
+    if S < 1 or M < 1 or V < 1:
+        raise ValueError(f"need S, M, V >= 1; got S={S}, M={M}, V={V}")
+    if V > 1 and S < 2:
+        raise ValueError(
+            f"interleaving needs >= 2 physical stages, got S={S}"
+        )
+    if V > 1 and M % S:
+        raise ValueError(
+            f"interleaved schedule needs num_microbatches divisible by "
+            f"num_stages (Megatron's round-robin microbatch groups); "
+            f"got M={M}, S={S}"
+        )
+    C = S * V          # logical pipeline depth
+    total = M * V      # forward (and backward) chunk-ticks per device
+
+    def fwd_item(k):
+        return (PIPE_FWD, (k // C) * S + k % S, (k // S) % V)
+
+    def bwd_item(k):
+        return (PIPE_BWD, (k // C) * S + k % S, V - 1 - (k // S) % V)
+
+    queues = []
+    for s in range(S):
+        warm = (
+            min(S - 1 - s, M) if V == 1
+            else min(2 * (S - 1 - s) + (V - 1) * S, total)
+        )
+        q = [fwd_item(k) for k in range(warm)]
+        for i in range(total - warm):
+            q.append(fwd_item(warm + i))
+            q.append(bwd_item(i))
+        q.extend(bwd_item(i) for i in range(total - warm, total))
+        queues.append(q)
+
+    done_f = [[None] * M for _ in range(C)]  # tick logical l finished fwd m
+    done_b = [[None] * M for _ in range(C)]
+    heads = [0] * S
+    work_rows, micro_rows, chunk_rows = [], [], []
+    t = 0
+    while any(heads[s] < len(queues[s]) for s in range(S)):
+        if t > 2 * total + 4 * C:
+            raise RuntimeError(
+                f"interleaved schedule deadlocked at tick {t} "
+                f"(S={S}, M={M}, V={V})"
+            )
+        row_w = [PIPE_IDLE] * S
+        row_m = [0] * S
+        row_c = [0] * S
+        for s in range(S):
+            if heads[s] >= len(queues[s]):
+                continue
+            kind, m, v = queues[s][heads[s]]
+            l = v * S + s
+            if kind == PIPE_FWD:
+                ready = l == 0 or (
+                    done_f[l - 1][m] is not None and done_f[l - 1][m] < t
+                )
+            else:
+                ready = done_f[l][m] is not None and done_f[l][m] < t
+                if l < C - 1:
+                    ready = ready and (
+                        done_b[l + 1][m] is not None and done_b[l + 1][m] < t
+                    )
+            if ready:
+                row_w[s], row_m[s], row_c[s] = kind, m, v
+        # Commit after scanning every stage (one-tick ppermute latency).
+        for s in range(S):
+            l = row_c[s] * S + s
+            if row_w[s] == PIPE_FWD:
+                done_f[l][row_m[s]] = t
+                heads[s] += 1
+            elif row_w[s] == PIPE_BWD:
+                done_b[l][row_m[s]] = t
+                heads[s] += 1
+        work_rows.append(row_w)
+        micro_rows.append(row_m)
+        chunk_rows.append(row_c)
+        t += 1
+
+    T = t
+    # The bubble guarantee the schedule exists for: fill+drain only ever
+    # costs the FIRST/LAST chunk's pipeline, 2(S-1) chunk-ticks total.
+    assert T <= 2 * total + 2 * (S - 1) or S == 1, (T, S, M, V)
+    work = np.asarray(work_rows, np.int32)
+    micro = np.asarray(micro_rows, np.int32)
+    chunk = np.asarray(chunk_rows, np.int32)
+
+    # Receive tables. The wire is a RING: up payloads come from device
+    # (s-1) mod S, down payloads from (s+1) mod S — the wrap edge is how
+    # an activation crosses a chunk boundary (logical v·S+S-1 -> (v+1)·S
+    # lives on device S-1 -> device 0). For V == 1 the wrap edge never
+    # carries a valid payload (its sender would be the last / first
+    # logical stage), so these tables equal the 1F1B chain tables.
+    recv_fwd = np.zeros((T, S), bool)
+    recv_fwd_m = np.zeros((T, S), np.int32)
+    recv_fwd_c = np.zeros((T, S), np.int32)
+    recv_bwd = np.zeros((T, S), bool)
+    recv_bwd_m = np.zeros((T, S), np.int32)
+    recv_bwd_c = np.zeros((T, S), np.int32)
+    if S > 1:
+        for tt in range(1, T):
+            for s in range(S):
+                sp = (s - 1) % S
+                if work[tt - 1, sp] == PIPE_FWD:
+                    l = chunk[tt - 1, sp] * S + sp
+                    if l < C - 1:
+                        recv_fwd[tt, s] = True
+                        recv_fwd_m[tt, s] = micro[tt - 1, sp]
+                        recv_fwd_c[tt, s] = (l + 1) // S
+                sn = (s + 1) % S
+                if work[tt - 1, sn] == PIPE_BWD:
+                    l = chunk[tt - 1, sn] * S + sn
+                    if l > 0:
+                        recv_bwd[tt, s] = True
+                        recv_bwd_m[tt, s] = micro[tt - 1, sn]
+                        recv_bwd_c[tt, s] = (l - 1) // S
+    # Per-chunk ring depths from the exact live intervals, keyed by
+    # ((device, chunk), m) so reuse conflicts are checked within each
+    # chunk's own ring (slot = chunk * depth + m % depth).
+    stash_iv = {}
+    cot_iv = {}
+    for s in range(S):
+        for v in range(V):
+            l = v * S + s
+            for m in range(M):
+                if l >= 1:
+                    stash_iv[((s, v), m)] = (
+                        done_f[l - 1][m] + 1, done_b[l][m]
+                    )
+                if l <= C - 2:
+                    cot_iv[((s, v), m)] = (
+                        done_b[l + 1][m] + 1, done_b[l][m]
+                    )
+    stash_depth = _min_ring_depth(stash_iv, M - 1) if stash_iv else 1
+    cot_depth = _min_ring_depth(cot_iv, M - 1) if cot_iv else 1
+    if stash_depth > min(M, 2 * S if V > 1 else S):
+        raise RuntimeError(
+            f"interleaved stash depth {stash_depth} exceeds the "
+            f"documented bound min(M, 2S) at S={S}, M={M}, V={V}"
+        )
+    return ScheduleTicks(
+        work, micro, chunk,
+        recv_fwd, recv_fwd_m, recv_fwd_c,
+        recv_bwd, recv_bwd_m, recv_bwd_c,
+        T, stash_depth, cot_depth, V,
+    )
+
+
 @dataclasses.dataclass
 class PipelineEngine:
     """GPipe-style pipeline engine over the `'stage'` mesh axis.
@@ -367,21 +585,50 @@ class PipelineEngine:
     #   count can scale until the bubble is negligible. Gradients and BN
     #   state match "gpipe" exactly (same per-microbatch math, same
     #   fold order); only the schedule and its memory change.
+    # * "interleaved" — Megatron's interleaved virtual pipeline
+    #   (Narayanan et al. SC'21): `stages` holds S·V chunks, device s
+    #   owns the NON-contiguous set {s, s+S, ...}, and the 1F1B tick
+    #   program generalizes to (microbatch, chunk) pairs riding a RING
+    #   ppermute (the wrap edge carries chunk-boundary hops). Each
+    #   chunk-tick is 1/V of a stage-tick of compute, so the fill/drain
+    #   bubble drops to (S-1)/(V·M+S-1) — the 1F1B floor divided by V —
+    #   at the price of deeper activation rings (V rings of depth
+    #   <= min(M, 2S) instead of one of depth min(S, M)) and one
+    #   ppermute per chunk-tick. Needs M % S == 0 when V > 1.
     schedule: str = "gpipe"
+    # Model chunks per device under schedule="interleaved" (V). 1 keeps
+    # one chunk per device (the plain 1F1B tick tables).
+    virtual_stages: int = 1
 
     def __post_init__(self):
         mesh = self.mesh
-        if self.schedule not in ("gpipe", "1f1b"):
+        if self.schedule not in ("gpipe", "1f1b", "interleaved"):
             raise ValueError(
-                f"schedule must be 'gpipe' or '1f1b', got {self.schedule!r}"
+                f"schedule must be 'gpipe', '1f1b' or 'interleaved', "
+                f"got {self.schedule!r}"
+            )
+        if self.virtual_stages < 1:
+            raise ValueError(
+                f"virtual_stages must be >= 1, got {self.virtual_stages}"
+            )
+        if self.virtual_stages > 1 and self.schedule != "interleaved":
+            raise ValueError(
+                "virtual_stages > 1 requires schedule='interleaved' "
+                "(gpipe/1f1b run exactly one chunk per device)"
             )
         if "stage" not in mesh.axis_names:
             raise ValueError("pipeline mesh needs a 'stage' axis")
         self.num_stages = mesh.shape["stage"]
-        if self.num_stages != len(self.stages):
+        # V chunks per device; C = S·V logical pipeline stages. For the
+        # non-interleaved schedules V == 1 and chunks == stages.
+        self._V = self.virtual_stages if self.schedule == "interleaved" \
+            else 1
+        self.num_chunks = self.num_stages * self._V
+        if self.num_chunks != len(self.stages):
             raise ValueError(
-                f"{len(self.stages)} stages but mesh 'stage' axis has size "
-                f"{self.num_stages}"
+                f"{len(self.stages)} stage chunks but mesh 'stage' axis "
+                f"size {self.num_stages} x virtual_stages {self._V} "
+                f"needs {self.num_chunks}"
             )
         self._repl = NamedSharding(mesh, P())
         self._batch = NamedSharding(mesh, P(("data",)))
@@ -425,10 +672,15 @@ class PipelineEngine:
             # specs eagerly). Construction is where a protocol violation
             # should be loud.
             self._opt_param_fields()
-        # 1F1B tick tables are static in (S, M): build once, fail early.
-        self._sched_1f1b = (
-            build_1f1b_schedule(self.num_stages, self.num_microbatches)
-            if self.schedule == "1f1b" else None
+        # Hand-scheduled tick tables are static in (S, M, V): build once,
+        # fail early. "1f1b" rides the generalized builder at V=1, whose
+        # tables are bit-identical to `build_1f1b_schedule`'s
+        # (tests/test_pipeline_schedule.py pins the reduction).
+        self._sched = (
+            build_interleaved_schedule(
+                self.num_stages, self.num_microbatches, self._V
+            )
+            if self.schedule in ("1f1b", "interleaved") else None
         )
 
         donate = (0,) if self.donate else ()
@@ -452,17 +704,21 @@ class PipelineEngine:
                 params, state, opt_state, jnp.zeros((), jnp.int32)
             )
             return jax.device_put(ts, self._repl)
-        # Stage-local: per-stage flats become rows of (S, maxP) / (S, maxS)
-        # arrays sharded over 'stage'. Each stage is initialized, moved to
-        # HOST memory, and packed there before the next stage initializes
-        # (so at most ONE stage's params are device-resident at a time),
-        # then the stacked array materializes shard-by-shard
-        # (make_array_from_callback) — the point of this mode is that the
-        # whole model doesn't fit per device, so init must never assemble
-        # it on one.
+        # Stage-local: per-chunk flats become rows of (S·V, maxP) /
+        # (S·V, maxS) arrays sharded over 'stage'. Rows are DEVICE-MAJOR
+        # (`staging.row_of_logical`): row s·V + v holds logical chunk
+        # v·S + s, so the P('stage') sharding lands each device's V
+        # interleaved chunks on it as local rows 0..V-1 (identity when
+        # V == 1). Each chunk is initialized, moved to HOST memory, and
+        # packed there before the next chunk initializes (so at most ONE
+        # chunk's params are device-resident at a time), then the stacked
+        # array materializes shard-by-shard (make_array_from_callback) —
+        # the point of this mode is that the whole model doesn't fit per
+        # device, so init must never assemble it on one.
         p_rows, s_rows = [], []
-        for i, stage in enumerate(self.stages):
-            p, s = stage.init(jax.random.fold_in(rng, i))
+        for r in range(self.num_chunks):
+            i = logical_of_row(r, self.num_stages, self._V)
+            p, s = self.stages[i].init(jax.random.fold_in(rng, i))
             p_rows.append(_pack_np(jax.device_get(p), self._psize))
             s_rows.append(_pack_np(jax.device_get(s), self._ssize))
             del p, s
@@ -500,15 +756,24 @@ class PipelineEngine:
             return ts.params
         flat = _to_host(ts.params)
         return tuple(
-            _unpack(flat[i], self._param_avals[i])
-            for i in range(self.num_stages)
+            _unpack(
+                flat[row_of_logical(i, self.num_stages, self._V)],
+                self._param_avals[i],
+            )
+            for i in range(self.num_chunks)
         )
 
     # ---------------------------------------------- checkpoint canonical
 
     def _unpack_stages(self, flat_host, avals):
+        """Device-major packed rows -> LOGICAL-order per-chunk tuple (the
+        canonical checkpoint order; identity permutation at V == 1)."""
         return tuple(
-            _unpack(flat_host[i], avals[i]) for i in range(self.num_stages)
+            _unpack(
+                flat_host[row_of_logical(i, self.num_stages, self._V)],
+                avals[i],
+            )
+            for i in range(self.num_chunks)
         )
 
     def _opt_param_fields(self) -> dict:
@@ -575,20 +840,26 @@ class PipelineEngine:
         this engine's runtime layout and placement."""
         if not self.stage_local_params:
             return jax.device_put(ts, self._repl)
-        flat_p = self._stack_local(
-            [_pack_np(p, self._psize) for p in ts.params]
-        )
-        flat_s = self._stack_local(
-            [_pack_np(s, self._ssize) for s in ts.model_state]
-        )
+
+        def rows(tree_tuple, size):
+            """Logical-order per-chunk tuple -> device-major packed rows
+            (the storage layout `init_state` builds)."""
+            return [
+                _pack_np(
+                    tree_tuple[logical_of_row(r, self.num_stages, self._V)],
+                    size,
+                )
+                for r in range(self.num_chunks)
+            ]
+
+        flat_p = self._stack_local(rows(ts.params, self._psize))
+        flat_s = self._stack_local(rows(ts.model_state, self._ssize))
 
         follows = self._opt_param_fields()
 
         def pack_opt_field(k, v):
             if follows[k]:
-                return self._stack_local(
-                    [_pack_np(m, self._psize) for m in v]
-                )
+                return self._stack_local(rows(v, self._psize))
             return jax.device_put(jnp.asarray(v), self._repl)
 
         opt_p = type(ts.opt_state)(
@@ -619,6 +890,8 @@ class PipelineEngine:
     def _make_step(self, train: bool):
         S = self.num_stages
         M = self.num_microbatches
+        V = self._V
+        C = self.num_chunks
         mesh = self.mesh
         bn_axis = "data" if self.sync_bn else None
         cdt = self.compute_dtype
@@ -628,16 +901,18 @@ class PipelineEngine:
             else self.stages
         )
 
-        def stage_params(params, i):
-            """Stage i's param pytree from either representation. In
-            stage-local mode every device holds ONLY its own stage's
-            (1, maxP) slice; the unpack is differentiable, so the grad
-            wrt the flat slice is the full stage-i gradient."""
-            return _unpack(params[0], self._param_avals[i]) if local \
+        def stage_params(params, i, row=0):
+            """Logical chunk i's param pytree from either representation.
+            In stage-local mode every device holds ONLY its own chunks'
+            (V, maxP) slice, device-major, so local row `row` (= the
+            chunk index v on the owning device) selects it; the unpack
+            is differentiable, so the grad wrt the flat slice is the
+            full chunk-i gradient."""
+            return _unpack(params[row], self._param_avals[i]) if local \
                 else params[i]
 
-        def stage_state(state, i):
-            return _unpack(state[0], self._state_avals[i]) if local \
+        def stage_state(state, i, row=0):
+            return _unpack(state[row], self._state_avals[i]) if local \
                 else state[i]
 
         def program_setup(images):
@@ -783,108 +1058,156 @@ class PipelineEngine:
             )
             return loss_sum, (logits, new_state, is_last)
 
-        sched = self._sched_1f1b
+        sched = self._sched
+        interleaved = self.schedule == "interleaved"
 
-        def pipeline_1f1b(params, model_state, images, labels, step):
-            """Hand-scheduled 1F1B (PipeDream-flush) forward+backward on
-            ONE device. Unlike `pipeline_forward` (whose backward is
-            autodiff through the whole tick scan, saving every tick's
-            residuals — O(M) live activations), this runs the static
-            `build_1f1b_schedule` tick tables: forward ticks stash only
-            the stage's in-flight input window into a min(S, M)-deep ring
-            buffer; backward ticks re-run the stage under `jax.vjp` on
-            the stashed input (recompute is exact: BN normalizes with
-            batch statistics in train mode, and the (stage, microbatch)
-            dropout key is deterministic), seed it with the cotangent the
-            down-wire delivered (or the loss gradient on the last stage),
-            accumulate the parameter gradient in place, and send the
-            input-cotangent one hop upstream. Two wires run concurrently
-            — activations ppermute up, cotangents ppermute down — so the
-            backward schedule interleaves with the forward instead of
-            running as a full reversed drain.
+        def pipeline_ticks(params, model_state, images, labels, step,
+                           run_backward: bool):
+            """Hand-scheduled tick program on ONE device — 1F1B
+            (PipeDream-flush) when V == 1, Megatron's interleaved
+            virtual pipeline when V > 1. Unlike `pipeline_forward`
+            (whose backward is autodiff through the whole tick scan,
+            saving every tick's residuals — O(M) live activations), this
+            runs the static `build_interleaved_schedule` tick tables:
+            each tick names a (microbatch, chunk) pair; forward ticks
+            stash only the chunk's in-flight input window into a
+            per-chunk ring buffer (V·R rows, slot v·R + m mod R);
+            backward ticks re-run the chunk under `jax.vjp` on the
+            stashed input (recompute is exact: BN normalizes with batch
+            statistics in train mode, and the (logical chunk,
+            microbatch) dropout key is deterministic), seed it with the
+            cotangent the down-wire delivered (or the loss gradient on
+            the last logical chunk), accumulate the parameter gradient
+            in place, and send the input-cotangent one hop upstream.
+            Two wires run concurrently — activations ppermute up,
+            cotangents ppermute down. Under 1F1B the wires are chains;
+            under interleaving they are RINGS, whose wrap edge carries a
+            chunk-boundary hop (logical v·S+S-1 -> (v+1)·S crosses from
+            device S-1 back to device 0), so activations traverse all
+            S·V-1 logical hops over S physical devices.
 
             Returns (loss_sum, logits, new_state, grads, is_last); grads
             are the UNNORMALIZED sum over microbatches (the caller
             divides by its loss normalizer — a linear pull-out of the
-            same scaling `jax.grad` applies under "gpipe")."""
+            same scaling `jax.grad` applies under "gpipe").
+
+            `run_backward=False` replays only the forward ticks (the
+            interleaved EVAL path: backward/bubble ticks skip the chunk
+            apply via `lax.cond`, the cotangent wire/ring is elided,
+            grads return None) — the forward-side receive tables and
+            ring slots are valid on their own because a slot's forward
+            consumption always precedes the backward consumption it was
+            sized for."""
             images, mb, avals, rows, num_classes, buf_size, wire_dt = (
                 program_setup(images)
             )
             T, R, Rc = sched.num_ticks, sched.stash_depth, sched.cot_depth
             # Trace-time record for the structural memory tests: the
-            # activation stash traced into this step is (R, buf_size).
+            # activation stash traced into this step is (V*R, buf_size).
             self._last_1f1b_trace = {
                 "num_ticks": T, "stash_depth": R, "cot_depth": Rc,
-                "buf_size": buf_size,
+                "buf_size": buf_size, "num_virtual": V,
             }
             work_tab = jnp.asarray(sched.work)
             micro_tab = jnp.asarray(sched.micro)
+            chunk_tab = jnp.asarray(sched.chunk)
             recv_f = jnp.asarray(sched.recv_fwd)
             recv_f_m = jnp.asarray(sched.recv_fwd_m)
+            recv_f_c = jnp.asarray(sched.recv_fwd_c)
             recv_b = jnp.asarray(sched.recv_bwd)
             recv_b_m = jnp.asarray(sched.recv_bwd_m)
+            recv_b_c = jnp.asarray(sched.recv_bwd_c)
             s_idx = lax.axis_index("stage")
             images_mbs = images.reshape((M, mb) + images.shape[1:])
-            labels_mbs = labels.reshape((M, -1))
+            labels_mbs = labels.reshape((M, -1)) if run_backward else None
             rng_base = jax.random.fold_in(
                 jax.random.fold_in(jax.random.PRNGKey(0), step),
                 lax.axis_index("data"),
             )
 
-            def make_branch(i):
-                in_aval, out_aval = avals[i]
+            def make_chunk(i, vv):
+                """Device i's chunk vv = logical pipeline stage vv*S+i
+                (round-robin placement; V=1 keeps chunk i on device
+                i)."""
+                l = vv * S + i
+                in_aval = avals[l][0]
 
-                def branch(operand):
+                def run(operand):
                     state, stash, cots, grads, m, w, rng = operand
                     ctx = Context(
-                        train=True, bn_axis=bn_axis, rng=rng, dtype=cdt
+                        train=train, bn_axis=bn_axis, rng=rng, dtype=cdt
                     )
-                    p_i = stage_params(params, i)
-                    s_i = stage_state(state, i)
-                    # Stage 0's input batch is device-resident, so it is
-                    # never stashed: both work kinds index images_mbs.
-                    if i == 0:
+                    p_l = stage_params(params, l, vv)
+                    s_l = stage_state(state, l, vv)
+                    # Logical chunk 0's input batch is device-resident,
+                    # so it is never stashed: both work kinds index
+                    # images_mbs.
+                    if l == 0:
                         x = lax.dynamic_index_in_dim(images_mbs, m, 0, False)
                     else:
                         x = _unpack(
-                            lax.dynamic_index_in_dim(stash, m % R, 0, False),
+                            lax.dynamic_index_in_dim(
+                                stash, vv * R + m % R, 0, False
+                            ),
                             in_aval,
                         )
 
                     def fwd(_):
-                        y, new_si = exec_stages[i].apply(p_i, s_i, x, ctx)
+                        y, new_si = exec_stages[l].apply(p_l, s_l, x, ctx)
                         y_pad = _pack(y, buf_size, wire_dt)
                         # Bubble (idle) ticks run this branch on garbage
                         # in SPMD lockstep: mask state and output.
                         valid = w == PIPE_FWD
                         if local:
-                            packed = _pack(new_si, self._ssize)[None, :]
-                            new_state = jnp.where(valid, packed, state)
+                            packed = _pack(new_si, self._ssize)
+                            new_state = state.at[vv].set(
+                                jnp.where(valid, packed, state[vv])
+                            )
                         else:
                             masked = jax.tree_util.tree_map(
                                 lambda new, old: jnp.where(valid, new, old),
-                                new_si, state[i],
+                                new_si, state[l],
                             )
                             new_state = tuple(
-                                masked if j == i else state[j]
-                                for j in range(S)
+                                masked if j == l else state[j]
+                                for j in range(C)
                             )
                         y_pad = jnp.where(
                             valid, y_pad, jnp.zeros_like(y_pad)
                         )
+                        if not run_backward:
+                            return y_pad, new_state
                         return (
                             y_pad, jnp.zeros((buf_size,), wire_dt),
                             new_state, grads,
                         )
 
+                    if not run_backward:
+                        # Eval replays the train tables, where half the
+                        # ticks are backward work. Executing the masked
+                        # forward there (the train path's SPMD-lockstep
+                        # convention for bubble ticks) would double eval
+                        # compute — the cond skips the chunk apply at
+                        # runtime instead. Safe per-device: no
+                        # collective lives inside the branch (the ring
+                        # ppermute is outside, in `tick`).
+                        return lax.cond(
+                            w == PIPE_FWD,
+                            fwd,
+                            lambda _: (
+                                jnp.zeros((buf_size,), wire_dt), state,
+                            ),
+                            0,
+                        )
+
                     def bwd(_):
-                        if i == S - 1:
+                        if l == C - 1:
                             lbl = lax.dynamic_index_in_dim(
                                 labels_mbs, m, 0, False
                             )
 
                             def f(p, xx):
-                                y, _ = exec_stages[i].apply(p, s_i, xx, ctx)
+                                y, _ = exec_stages[l].apply(p, s_l, xx, ctx)
                                 y_pad = _pack(y, buf_size, wire_dt)
                                 logits_mb = (
                                     y_pad[: rows * num_classes]
@@ -896,37 +1219,38 @@ class PipelineEngine:
                                     * valid_count(lbl)
                                 )
 
-                            _, vjp_fn = jax.vjp(f, p_i, x)
+                            _, vjp_fn = jax.vjp(f, p_l, x)
                             gp, gx = vjp_fn(jnp.ones((), jnp.float32))
                         else:
 
                             def f(p, xx):
-                                y, _ = exec_stages[i].apply(p, s_i, xx, ctx)
+                                y, _ = exec_stages[l].apply(p, s_l, xx, ctx)
                                 return _pack(y, buf_size, wire_dt)
 
-                            _, vjp_fn = jax.vjp(f, p_i, x)
+                            _, vjp_fn = jax.vjp(f, p_l, x)
                             gp, gx = vjp_fn(
                                 lax.dynamic_index_in_dim(
-                                    cots, m % Rc, 0, False
+                                    cots, vv * Rc + m % Rc, 0, False
                                 )
                             )
-                        # Stage 0 has no upstream (and in LM mode an
-                        # integer input whose cotangent is symbolic-zero).
+                        # Logical chunk 0 has no upstream (and in LM
+                        # mode an integer input whose cotangent is
+                        # symbolic-zero).
                         down = (
-                            jnp.zeros((buf_size,), wire_dt) if i == 0
+                            jnp.zeros((buf_size,), wire_dt) if l == 0
                             else _pack(gx, buf_size, wire_dt)
                         )
                         if local:
-                            new_grads = (
-                                grads + _pack(gp, self._psize)[None, :]
+                            new_grads = grads.at[vv].add(
+                                _pack(gp, self._psize)
                             )
                         else:
-                            g_i = jax.tree_util.tree_map(
-                                jnp.add, grads[i], gp
+                            g_l = jax.tree_util.tree_map(
+                                jnp.add, grads[l], gp
                             )
                             new_grads = tuple(
-                                g_i if j == i else grads[j]
-                                for j in range(S)
+                                g_l if j == l else grads[j]
+                                for j in range(C)
                             )
                         return (
                             jnp.zeros((buf_size,), wire_dt), down, state,
@@ -935,22 +1259,46 @@ class PipelineEngine:
 
                     return lax.cond(w == PIPE_BWD, bwd, fwd, 0)
 
+                return run
+
+            def make_branch(i):
+                runs = [make_chunk(i, vv) for vv in range(V)]
+
+                def branch(operand):
+                    state, stash, cots, grads, m, v, w, rng = operand
+                    inner = (state, stash, cots, grads, m, w, rng)
+                    if V == 1:
+                        return runs[0](inner)
+                    return lax.switch(v, runs, inner)
+
                 return branch
 
             branches = [make_branch(i) for i in range(S)]
-            up_pairs = [(i, i + 1) for i in range(S - 1)]
-            down_pairs = [(i + 1, i) for i in range(S - 1)]
+            if interleaved:
+                # Ring wires: the wrap edge is the chunk-boundary hop.
+                up_pairs = [(i, (i + 1) % S) for i in range(S)]
+                down_pairs = [((i + 1) % S, i) for i in range(S)]
+            else:
+                up_pairs = [(i, i + 1) for i in range(S - 1)]
+                down_pairs = [(i + 1, i) for i in range(S - 1)]
 
             def tick(carry, t):
-                up_buf, down_buf, stash, cots, state, out_stack, grads = carry
+                if run_backward:
+                    (up_buf, down_buf, stash, cots, state, out_stack,
+                     grads) = carry
+                else:
+                    up_buf, stash, state, out_stack = carry
+                    down_buf = None
+                    cots = grads = jnp.zeros((), jnp.float32)
                 w = work_tab[t, s_idx]
                 m = micro_tab[t, s_idx]
+                v = chunk_tab[t, s_idx]
                 # Receive: the wire buffers hold tick t-1's permute
                 # output; the static tables say whether that payload is
-                # real and which microbatch's ring slot it belongs in
-                # (receive-before-compute, so a tick may consume the
-                # activation/cotangent that just arrived).
-                slot = recv_f_m[t, s_idx] % R
+                # real and which (chunk, microbatch) ring slot it
+                # belongs in (receive-before-compute, so a tick may
+                # consume the activation/cotangent that just arrived).
+                slot = recv_f_c[t, s_idx] * R + recv_f_m[t, s_idx] % R
                 stash = lax.dynamic_update_index_in_dim(
                     stash,
                     jnp.where(
@@ -959,24 +1307,34 @@ class PipelineEngine:
                     ),
                     slot, 0,
                 )
-                cslot = recv_b_m[t, s_idx] % Rc
-                cots = lax.dynamic_update_index_in_dim(
-                    cots,
-                    jnp.where(
-                        recv_b[t, s_idx], down_buf,
-                        lax.dynamic_index_in_dim(cots, cslot, 0, False),
-                    ),
-                    cslot, 0,
-                )
-                # Per-(stage, microbatch) dropout key — identical at the
-                # forward tick and its backward-tick recompute.
+                if run_backward:
+                    cslot = (
+                        recv_b_c[t, s_idx] * Rc + recv_b_m[t, s_idx] % Rc
+                    )
+                    cots = lax.dynamic_update_index_in_dim(
+                        cots,
+                        jnp.where(
+                            recv_b[t, s_idx], down_buf,
+                            lax.dynamic_index_in_dim(cots, cslot, 0, False),
+                        ),
+                        cslot, 0,
+                    )
+                # Per-(logical chunk, microbatch) dropout key — identical
+                # at the forward tick and its backward-tick recompute
+                # (v*S + s_idx == s_idx when V == 1).
                 rng = jax.random.fold_in(
-                    jax.random.fold_in(rng_base, s_idx), m
+                    jax.random.fold_in(rng_base, v * S + s_idx), m
                 )
-                up_out, down_out, state, grads = lax.switch(
-                    s_idx, branches, (state, stash, cots, grads, m, w, rng)
+                operand = (state, stash, cots, grads, m, v, w, rng)
+                if run_backward:
+                    up_out, down_out, state, grads = lax.switch(
+                        s_idx, branches, operand
+                    )
+                else:
+                    up_out, state = lax.switch(s_idx, branches, operand)
+                write = (
+                    (w == PIPE_FWD) & (s_idx == S - 1) & (v == V - 1)
                 )
-                write = (w == PIPE_FWD) & (s_idx == S - 1)
                 logits_mb = (
                     up_out[: rows * num_classes]
                     .reshape(rows, num_classes)
@@ -992,29 +1350,51 @@ class PipelineEngine:
                 )
                 if S > 1:
                     up_buf = lax.ppermute(up_out, "stage", up_pairs)
-                    down_buf = lax.ppermute(down_out, "stage", down_pairs)
+                    if run_backward:
+                        down_buf = lax.ppermute(
+                            down_out, "stage", down_pairs
+                        )
                 else:
-                    up_buf, down_buf = up_out, down_out
-                return (
-                    up_buf, down_buf, stash, cots, state, out_stack, grads
-                ), None
+                    up_buf = up_out
+                    if run_backward:
+                        down_buf = down_out
+                if run_backward:
+                    return (
+                        up_buf, down_buf, stash, cots, state, out_stack,
+                        grads,
+                    ), None
+                return (up_buf, stash, state, out_stack), None
 
-            if local:
-                grads0 = jnp.zeros((1, self._psize), jnp.float32)
+            if run_backward:
+                if local:
+                    grads0 = jnp.zeros((V, self._psize), jnp.float32)
+                else:
+                    grads0 = jax.tree_util.tree_map(jnp.zeros_like, params)
+                carry0 = (
+                    jnp.zeros((buf_size,), wire_dt),
+                    jnp.zeros((buf_size,), wire_dt),
+                    # per-chunk activation rings (row v*R + m%R)
+                    jnp.zeros((V * R, buf_size), wire_dt),
+                    # per-chunk cotangent rings
+                    jnp.zeros((V * Rc, buf_size), wire_dt),
+                    model_state,
+                    jnp.zeros((M, rows, num_classes), jnp.float32),
+                    grads0,
+                )
+                (_, _, _, _, new_state, out_stack, grads), _ = lax.scan(
+                    tick, carry0, jnp.arange(T)
+                )
             else:
-                grads0 = jax.tree_util.tree_map(jnp.zeros_like, params)
-            carry0 = (
-                jnp.zeros((buf_size,), wire_dt),
-                jnp.zeros((buf_size,), wire_dt),
-                jnp.zeros((R, buf_size), wire_dt),   # activation ring
-                jnp.zeros((Rc, buf_size), wire_dt),  # cotangent ring
-                model_state,
-                jnp.zeros((M, rows, num_classes), jnp.float32),
-                grads0,
-            )
-            (_, _, _, _, new_state, out_stack, grads), _ = lax.scan(
-                tick, carry0, jnp.arange(T)
-            )
+                carry0 = (
+                    jnp.zeros((buf_size,), wire_dt),
+                    jnp.zeros((V * R, buf_size), wire_dt),
+                    model_state,
+                    jnp.zeros((M, rows, num_classes), jnp.float32),
+                )
+                (_, _, new_state, out_stack), _ = lax.scan(
+                    tick, carry0, jnp.arange(T)
+                )
+                grads = None
             logits = out_stack.reshape(M * rows, num_classes)
             is_last = (s_idx == S - 1).astype(logits.dtype)
             loss_sum = (
@@ -1023,11 +1403,11 @@ class PipelineEngine:
             return loss_sum, logits, new_state, grads, is_last
 
         def reassemble_state(new_state, s_idx):
-            """Each device updated only its own stage's BN state; rebuild
+            """Each device updated only its own chunks' BN state; rebuild
             the replicated tuple by masked psum over 'stage'."""
             out = []
-            for i in range(S):
-                mask = (s_idx == i).astype(jnp.float32)
+            for i in range(C):
+                mask = (s_idx == chunk_owner(i, S)).astype(jnp.float32)
                 out.append(
                     jax.tree_util.tree_map(
                         lambda v: lax.psum(v * mask, "stage"), new_state[i]
@@ -1085,15 +1465,15 @@ class PipelineEngine:
                 # discipline.
                 loss_norm = jnp.maximum(valid_count(labels), 1.0)
 
-                if self.schedule == "1f1b":
+                if sched is not None:  # "1f1b" or "interleaved"
                     # Hand-scheduled fwd+bwd: grads come back as the
                     # unnormalized microbatch sum; dividing by loss_norm
                     # is the same linear scaling jax.grad applies to the
                     # gpipe loss below.
                     loss_sum, logits, new_state, grads, is_last = (
-                        pipeline_1f1b(
+                        pipeline_ticks(
                             ts.params, ts.model_state, images, labels,
-                            ts.step,
+                            ts.step, run_backward=True,
                         )
                     )
                     grads = jax.tree_util.tree_map(
@@ -1148,9 +1528,18 @@ class PipelineEngine:
             check_vma=False,
         )
         def evstep(ts: TrainState, images, labels):
-            loss_sum, (logits, _, is_last) = pipeline_forward(
-                ts.params, ts.model_state, images, labels, ts.step
-            )
+            if interleaved:
+                # The fill-drain forward assumes one chunk per device;
+                # interleaved eval replays the tick tables' forward
+                # entries instead (backward ticks are masked no-ops).
+                loss_sum, logits, _, _, is_last = pipeline_ticks(
+                    ts.params, ts.model_state, images, labels, ts.step,
+                    run_backward=False,
+                )
+            else:
+                loss_sum, (logits, _, is_last) = pipeline_forward(
+                    ts.params, ts.model_state, images, labels, ts.step
+                )
             return metrics_from(logits, labels, loss_sum, is_last)
 
         return evstep

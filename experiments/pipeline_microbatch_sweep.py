@@ -1,17 +1,23 @@
 """Pipeline microbatch sweep: measured time/batch vs the bubble math,
-for BOTH schedules (GPipe fill-drain and 1F1B/PipeDream-flush).
+for ALL THREE schedules (GPipe fill-drain, 1F1B/PipeDream-flush, and
+the interleaved virtual pipeline at V=2).
 
 The reference's headline pipeline finding is that one-batch-in-flight
 model parallelism is ~4x slower than data parallelism
 (`/root/reference/Readme.md:283-292`) — a pure schedule artifact: with S
 stages and M microbatches the pipeline runs M+S-1 ticks for M microbatches
 of work, so time/batch scales like (M+S-1)/M (=S at the reference's M=1,
-->1 as M grows). Both schedules share that bubble curve; what separates
+->1 as M grows). GPipe and 1F1B share that bubble curve; what separates
 them is MEMORY. GPipe holds all M microbatch activations live through the
 backward (the stash grows O(M), so the bubble can only be shrunk by
-spending memory), while 1F1B caps the live window at min(S, M) — the
-sweep records each engine's traced stash metadata next to its throughput
-so the figure shows the schedule trade directly.
+spending memory), while 1F1B caps the live window at min(S, M). The
+interleaved schedule (same model split into S·V chunks dealt
+round-robin) is the only one that moves the bubble FLOOR: its ideal
+speedup curve is M·S·V/(M·V+S-1) instead of M·S/(M+S-1), at the price of
+V deeper stash rings — the sweep records each engine's traced stash
+metadata next to its throughput so the figure shows both trades
+directly. (Interleaved rows need M % S == 0, so its curve starts at
+M=S.)
 
 Run: python experiments/pipeline_microbatch_sweep.py
 """
@@ -40,6 +46,7 @@ def main() -> None:
     from distributed_model_parallel_tpu.training.optim import SGD
 
     S = 4
+    V = 2  # interleaved chunks per device
     mesh = make_mesh(MeshSpec(data=2, stage=S))
     stages = [
         L.sequential(L.conv2d(3, 32, 3, stride=1, padding=1), L.relu()),
@@ -47,19 +54,39 @@ def main() -> None:
         L.sequential(L.conv2d(32, 32, 3, stride=1, padding=1), L.relu()),
         L.sequential(L.global_avg_pool(), L.linear(32, 10)),
     ]
+    # The SAME network split twice as fine for the interleaved engine:
+    # S*V = 8 chunks, dealt round-robin (device s owns chunks s, s+S).
+    chunks = [
+        L.sequential(L.conv2d(3, 32, 3, stride=1, padding=1), L.relu()),
+        *[
+            L.sequential(
+                L.conv2d(32, 32, 3, stride=1, padding=1), L.relu()
+            )
+            for _ in range(S * V - 2)
+        ],
+        L.sequential(L.global_avg_pool(), L.linear(32, 10)),
+    ]
     rng = np.random.RandomState(0)
     batch = 64
     images = rng.rand(batch, 8, 8, 3).astype(np.float32)
     labels = rng.randint(0, 10, size=(batch,)).astype(np.int32)
 
-    schedules = ("gpipe", "1f1b")
+    schedules = ("gpipe", "1f1b", "interleaved")
     rows = {sched: [] for sched in schedules}
     for m in (1, 2, 4, 8, 16):
         for sched in schedules:
-            engine = PipelineEngine(
-                stages, SGD(), mesh, num_microbatches=m, donate=False,
-                schedule=sched,
-            )
+            if sched == "interleaved":
+                if m % S:  # Megatron's M % S == 0 constraint
+                    continue
+                engine = PipelineEngine(
+                    chunks, SGD(), mesh, num_microbatches=m,
+                    donate=False, schedule=sched, virtual_stages=V,
+                )
+            else:
+                engine = PipelineEngine(
+                    stages, SGD(), mesh, num_microbatches=m,
+                    donate=False, schedule=sched,
+                )
             ts = engine.init_state(jax.random.PRNGKey(0))
             im, lb = engine.shard_batch(images, labels)
             lr = jnp.float32(0.05)
@@ -73,24 +100,33 @@ def main() -> None:
             jax.block_until_ready(ts)
             dt = (time.perf_counter() - t0) / iters
             # Live activation window per stage: GPipe's autodiff stash is
-            # every in-flight microbatch; 1F1B's is its static ring.
-            if sched == "1f1b":
-                stash = engine._sched_1f1b.stash_depth
-            else:
+            # every in-flight microbatch; the tick engines report their
+            # static ring (V rings of stash_depth under interleaving —
+            # each chunk's activation is 1/V the size, so V*depth ring
+            # rows cost the same bytes as depth full-stage stashes).
+            if sched == "gpipe":
                 stash = m
+            else:
+                stash = engine._sched.stash_depth * engine._V
             rows[sched].append(
                 {"M": m, "time_per_batch": dt, "live_activations": stash}
             )
-            print(f"{sched:>5} M={m:>2}: {dt:.3f} s/batch, "
+            print(f"{sched:>11} M={m:>2}: {dt:.3f} s/batch, "
                   f"live acts/stage={stash}", flush=True)
 
     for sched in schedules:
-        base = rows[sched][0]["time_per_batch"]  # M=1: reference schedule
+        # Speedups are vs the M=1 GPIPE run — the reference's
+        # one-batch-in-flight schedule (interleaved has no M=1 row).
+        base = rows["gpipe"][0]["time_per_batch"]
         for r in rows[sched]:
             m = r["M"]
-            r["speedup_vs_m1"] = round(base / r["time_per_batch"], 2)
-            # ideal time ratio t(M)/t(1) = (M+S-1) / (M*S)
-            r["ideal_speedup"] = round(m * S / (m + S - 1), 2)
+            r["speedup_vs_reference"] = round(base / r["time_per_batch"], 2)
+            # ideal time ratio vs one batch in flight: chunk-ticks are
+            # 1/V of a stage-tick, so t(M,V)/t(1) = (M·V+S-1)/(M·S·V);
+            # V=1 gives the familiar (M+S-1)/(M·S).
+            v = V if sched == "interleaved" else 1
+            r["ideal_speedup"] = round(m * S * v / (m * v + S - 1), 2)
+            r["bubble_fraction"] = round((S - 1) / (v * m + S - 1), 4)
 
     os.makedirs("pic", exist_ok=True)
     with open("experiments/pipeline_microbatch_sweep.json", "w") as f:
@@ -102,30 +138,39 @@ def main() -> None:
     import matplotlib.pyplot as plt
 
     ms = [r["M"] for r in rows["gpipe"]]
+    ms_i = [r["M"] for r in rows["interleaved"]]
     fig, (ax, ax2) = plt.subplots(1, 2, figsize=(11, 4))
-    ax.plot(ms, [r["speedup_vs_m1"] for r in rows["gpipe"]], marker="o",
+    ax.plot(ms, [r["speedup_vs_reference"] for r in rows["gpipe"]], marker="o",
             label="gpipe measured")
-    ax.plot(ms, [r["speedup_vs_m1"] for r in rows["1f1b"]], marker="^",
+    ax.plot(ms, [r["speedup_vs_reference"] for r in rows["1f1b"]], marker="^",
             label="1f1b measured")
+    ax.plot(ms_i, [r["speedup_vs_reference"] for r in rows["interleaved"]],
+            marker="d", label=f"interleaved V={V} measured")
     ax.plot(ms, [r["ideal_speedup"] for r in rows["gpipe"]], marker="s",
             linestyle="--", label="ideal  M·S/(M+S−1)")
+    ax.plot(ms_i, [r["ideal_speedup"] for r in rows["interleaved"]],
+            marker="x", linestyle=":",
+            label="ideal  M·S·V/(M·V+S−1)")
     ax.set_xscale("log", base=2)
     ax.set_xticks(ms)
     ax.set_xticklabels(ms)
     ax.set_xlabel("microbatches M")
     ax.set_ylabel("speedup vs M=1 (reference schedule)")
-    ax.set_title(f"bubble (S−1)/(M+S−1), S={S}: both schedules")
+    ax.set_title(f"bubble floor ÷V under interleaving, S={S}")
     ax.grid(alpha=0.3)
     ax.legend()
     ax2.plot(ms, [r["live_activations"] for r in rows["gpipe"]],
              marker="o", label="gpipe  (O(M))")
     ax2.plot(ms, [r["live_activations"] for r in rows["1f1b"]],
              marker="^", label="1f1b  (O(S): ring ≤ min(S, M))")
+    ax2.plot(ms_i, [r["live_activations"] for r in rows["interleaved"]],
+             marker="d",
+             label=f"interleaved V={V}  (V rings, 1/V-size chunks)")
     ax2.set_xscale("log", base=2)
     ax2.set_xticks(ms)
     ax2.set_xticklabels(ms)
     ax2.set_xlabel("microbatches M")
-    ax2.set_ylabel("live activations per stage")
+    ax2.set_ylabel("live activation ring rows per device")
     ax2.set_title("activation memory vs M")
     ax2.grid(alpha=0.3)
     ax2.legend()

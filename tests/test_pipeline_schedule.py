@@ -1,20 +1,27 @@
-"""Schedule-parity harness: 1F1B (PipeDream-flush) vs GPipe vs dense.
+"""Schedule-parity harness: 1F1B / interleaved virtual pipeline vs GPipe
+vs dense.
 
-The 1F1B schedule changes WHEN each stage runs each microbatch's forward
-and backward — never WHAT is computed. These tests pin that claim three
-ways (SURVEY.md §4 methodology: exact parity, not convergence curves):
+A pipeline schedule changes WHEN each device runs each microbatch's
+forward and backward — never WHAT is computed. These tests pin that
+claim three ways (SURVEY.md §4 methodology: exact parity, not
+convergence curves):
 
-* table level — `build_1f1b_schedule` emits a complete, dependency-valid
-  tick program whose span never exceeds GPipe's forward+backward span;
-* numeric level — gradients, parameter trajectories, and BN running
-  stats match GPipe and the dense single-device reference at rtol 1e-5,
-  including `stage_local_params=True` and `remat=True`;
-* structural level — the traced activation stash is a min(S, M)-deep
-  ring (O(S) memory), while GPipe's autodiff-through-scan materializes
-  per-tick residual stacks with an O(M) leading dimension.
+* table level — `build_1f1b_schedule` / `build_interleaved_schedule`
+  emit complete, dependency-valid tick programs; the interleaved span is
+  exactly 2MV + 2(S-1) ticks, so its tick-table idle fraction is
+  (S-1)/(V·M+S-1) — the 1F1B bubble floor divided by V — and the V=1
+  tables are BIT-IDENTICAL to the 1F1B tables;
+* numeric level — gradients, parameter trajectories, BN running stats,
+  and metrics match GPipe, 1F1B, and the dense single-device reference
+  at rtol 1e-5, including `stage_local_params=True` and `remat=True`;
+* structural level — the traced 1F1B activation stash is a
+  min(S, M)-deep ring (O(S) memory), while GPipe's autodiff-through-scan
+  materializes per-tick residual stacks with an O(M) leading dimension;
+  the interleaved stash is V rings of depth <= min(M, 2S).
 
-Default-run cases stay at S=2 / M<=4; larger S/M twins are `slow`
-(tier-1 budget — pytest.ini).
+Default-run cases stay at S=2 / M<=4 plus one interleaved S=2/V=2/M=4
+smoke; the full S×V×M parity sweep is `slow` (tier-1 budget —
+pytest.ini / tools/tier1.sh).
 """
 
 import re
@@ -28,8 +35,10 @@ from distributed_model_parallel_tpu.models import layers as L
 from distributed_model_parallel_tpu.parallel.pipeline import (
     PIPE_BWD,
     PIPE_FWD,
+    PIPE_IDLE,
     PipelineEngine,
     build_1f1b_schedule,
+    build_interleaved_schedule,
 )
 from distributed_model_parallel_tpu.runtime.mesh import MeshSpec, make_mesh
 from distributed_model_parallel_tpu.training.metrics import cross_entropy
@@ -57,6 +66,27 @@ def cnn_stages(num_stages: int, num_classes: int = 4):
             L.sequential(L.global_avg_pool(), L.linear(16, num_classes)),
         ]
     raise ValueError(f"no {num_stages}-stage test model")
+
+
+def cnn_chunks(num_chunks: int, num_classes: int = 4):
+    """BN-free chunk list of ANY length for the interleaved engine
+    (`stages` = S·V chunks) and for the C-physical-stage gpipe/1f1b
+    cross-check engines. Channel widths cycle so adjacent chunk
+    boundaries pad the wire buffer differently."""
+    widths = [32, 8, 16, 8, 32, 16, 8]
+    chunks, cin = [], 3
+    for i in range(num_chunks - 1):
+        cout = widths[i % len(widths)]
+        chunks.append(
+            L.sequential(
+                L.conv2d(cin, cout, 3, stride=1, padding=1), L.relu()
+            )
+        )
+        cin = cout
+    chunks.append(
+        L.sequential(L.global_avg_pool(), L.linear(cin, num_classes))
+    )
+    return chunks
 
 
 def bn_stages(num_classes: int = 4):
@@ -132,6 +162,97 @@ def test_schedule_tables_complete_and_dependency_valid(S, M):
     # The O(S) claim, at table level: ring depth is min(S, M), not M.
     assert sch.stash_depth <= min(S, M)
     assert sch.cot_depth <= min(S, M)
+
+
+@pytest.mark.parametrize("S", [1, 2, 3, 4, 8])
+@pytest.mark.parametrize("M", [1, 2, 3, 4, 8, 16])
+def test_interleaved_v1_reduces_exactly_to_1f1b(S, M):
+    """The acceptance-criteria reduction: at V=1 the generalized builder
+    emits BIT-IDENTICAL tables to `build_1f1b_schedule` (work, micro,
+    both receive tables, span, ring depths), with all-zero chunk
+    columns — so `schedule="1f1b"` riding the generalized runner is the
+    same program it always was."""
+    a = build_1f1b_schedule(S, M)
+    b = build_interleaved_schedule(S, M, 1)
+    np.testing.assert_array_equal(a.work, b.work)
+    np.testing.assert_array_equal(a.micro, b.micro)
+    np.testing.assert_array_equal(a.recv_fwd, b.recv_fwd)
+    np.testing.assert_array_equal(a.recv_fwd_m, b.recv_fwd_m)
+    np.testing.assert_array_equal(a.recv_bwd, b.recv_bwd)
+    np.testing.assert_array_equal(a.recv_bwd_m, b.recv_bwd_m)
+    assert a.num_ticks == b.num_ticks
+    assert a.stash_depth == b.stash_depth
+    assert a.cot_depth == b.cot_depth
+    assert (b.chunk == 0).all()
+    assert (b.recv_fwd_c == 0).all() and (b.recv_bwd_c == 0).all()
+
+
+@pytest.mark.parametrize("S", [2, 4])
+@pytest.mark.parametrize("V", [1, 2])
+@pytest.mark.parametrize("M", [4, 8])
+def test_interleaved_tables_complete_and_dependency_valid(S, V, M):
+    """Generalization of the 1F1B table test to logical stages
+    l = v·S + s: every (microbatch, chunk) forward and backward runs
+    exactly once on chunk l's owning device, producers precede consumers
+    across the one-tick ring hop, and each chunk's ring slots never
+    collide within the documented depth."""
+    sch = build_interleaved_schedule(S, M, V)
+    C = S * V
+    T = sch.num_ticks
+    fwd_tick = np.full((C, M), -1)
+    bwd_tick = np.full((C, M), -1)
+    for t in range(T):
+        for s in range(S):
+            if sch.work[t, s] == PIPE_IDLE:
+                continue
+            m = int(sch.micro[t, s])
+            l = int(sch.chunk[t, s]) * S + s
+            if sch.work[t, s] == PIPE_FWD:
+                assert fwd_tick[l, m] == -1, "duplicate forward"
+                fwd_tick[l, m] = t
+            else:
+                assert bwd_tick[l, m] == -1, "duplicate backward"
+                bwd_tick[l, m] = t
+    assert (fwd_tick >= 0).all() and (bwd_tick >= 0).all(), "missing work"
+    for l in range(C):
+        for m in range(M):
+            if l > 0:  # activation crosses one ring-ppermute hop
+                assert fwd_tick[l - 1, m] < fwd_tick[l, m]
+            if l < C - 1:  # cotangent crosses one ring-ppermute hop
+                assert bwd_tick[l + 1, m] < bwd_tick[l, m]
+            assert fwd_tick[l, m] < bwd_tick[l, m]
+    assert sch.stash_depth <= min(M, 2 * S)
+    assert sch.cot_depth <= min(M, 2 * S)
+
+
+@pytest.mark.parametrize("S", [2, 4])
+@pytest.mark.parametrize("V", [1, 2])
+@pytest.mark.parametrize("M", [4, 8])
+def test_interleaved_bubble_fraction_is_divided_by_v(S, V, M):
+    """THE acceptance-criteria structural assertion, from the tick table
+    itself: the interleaved span is exactly 2MV + 2(S-1) chunk-ticks for
+    2MV chunk-ticks of work per device, so the idle fraction is
+    (S-1)/(V·M+S-1) — not the 1F1B floor (S-1)/(M+S-1). Each chunk-tick
+    is 1/V of a stage-tick of compute, so at equal M the bubble TIME
+    divides by V."""
+    sch = build_interleaved_schedule(S, M, V)
+    T = sch.num_ticks
+    assert T == 2 * M * V + 2 * (S - 1)
+    idle = int((sch.work == PIPE_IDLE).sum())
+    frac = idle / (T * S)
+    assert frac == pytest.approx((S - 1) / (V * M + S - 1), abs=1e-12)
+    if V > 1:
+        floor_1f1b = (S - 1) / (M + S - 1)
+        assert frac < floor_1f1b
+
+
+def test_interleaved_builder_validation():
+    with pytest.raises(ValueError, match="divisible"):
+        build_interleaved_schedule(4, 6, 2)  # M % S != 0
+    with pytest.raises(ValueError, match="physical"):
+        build_interleaved_schedule(1, 4, 2)  # interleaving needs S >= 2
+    with pytest.raises(ValueError, match=">= 1"):
+        build_interleaved_schedule(2, 4, 0)
 
 
 # ------------------------------------------------- gradients / trajectory
@@ -214,6 +335,230 @@ def test_1f1b_remat_parity():
                                                (True, True)])
 def test_1f1b_stage_local_remat_parity_s4(stage_local, remat):
     assert_schedule_parity(S=4, M=8, stage_local=stage_local, remat=remat)
+
+
+def assert_interleaved_parity(S, V, M, stage_local=False, remat=False):
+    """One plain-SGD step (momentum 0, wd 0, lr 1) on the interleaved
+    engine: params_before - params_after IS the gradient. Pinned against
+    (a) `jax.grad` of the dense composition of the same S·V chunks, and
+    (b) gpipe AND 1f1b engines running the same chunk list as S·V
+    physical stages — a different mesh factorization (the data-parallel
+    width changes from 8/S to 8/(S·V)), but the pmean'd global gradient
+    and the psum'd metrics must not."""
+    C = S * V
+    chunks = cnn_chunks(C)
+    images, labels = batch(n=8 * M)
+    results = {}
+
+    def run(name, engine):
+        ts = engine.init_state(jax.random.PRNGKey(2))
+        before = engine.params_tree(ts)
+        after, metrics = _one_step_params(engine, ts, images, labels)
+        results[name] = (before, after, metrics)
+
+    run("interleaved", PipelineEngine(
+        chunks, SGD(momentum=0.0, weight_decay=0.0), mesh_for(S),
+        num_microbatches=M, donate=False, schedule="interleaved",
+        virtual_stages=V, stage_local_params=stage_local, remat=remat,
+    ))
+    for schedule in ("gpipe", "1f1b"):
+        run(schedule, PipelineEngine(
+            chunks, SGD(momentum=0.0, weight_decay=0.0), mesh_for(C),
+            num_microbatches=M, donate=False, schedule=schedule,
+            stage_local_params=stage_local, remat=remat,
+        ))
+
+    # Same chunk list + same init key => identical before-params
+    # everywhere; the dense reference gradient is computed once on them.
+    before = results["interleaved"][0]
+    state0 = tuple(c.init(jax.random.PRNGKey(0))[1] for c in chunks)
+    want = seq_grads(chunks, before, state0, images, labels)
+    for name, (b, a, _) in results.items():
+        for i in range(C):
+            for (path, x), y, w in zip(
+                jax.tree_util.tree_leaves_with_path(b[i]),
+                jax.tree_util.tree_leaves(a[i]),
+                jax.tree_util.tree_leaves(want[str(i)]),
+            ):
+                np.testing.assert_allclose(
+                    np.asarray(x) - np.asarray(y), np.asarray(w),
+                    rtol=1e-5, atol=1e-6,
+                    err_msg=f"{name} S={S} V={V} M={M} chunk {i} "
+                            f"{jax.tree_util.keystr(path)}",
+                )
+    mi = results["interleaved"][2]
+    for other in ("gpipe", "1f1b"):
+        mo = results[other][2]
+        for key in mi:
+            np.testing.assert_allclose(
+                float(mi[key]), float(mo[key]), rtol=1e-5,
+                err_msg=f"{other} {key}",
+            )
+
+
+def test_interleaved_matches_gpipe_1f1b_and_dense_smoke():
+    """The tier-1 smoke case of the S×V×M sweep (satellite: the full
+    sweep is `slow`)."""
+    assert_interleaved_parity(S=2, V=2, M=4)
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize(
+    "S,V,M",
+    [(2, 1, 4), (2, 1, 8), (2, 2, 8), (4, 1, 4), (4, 1, 8), (4, 2, 4),
+     (4, 2, 8)],
+)
+def test_interleaved_matches_gpipe_1f1b_and_dense_sweep(S, V, M):
+    assert_interleaved_parity(S=S, V=V, M=M)
+
+
+@pytest.mark.slow
+def test_interleaved_stage_local_params_parity():
+    assert_interleaved_parity(S=2, V=2, M=4, stage_local=True)
+
+
+@pytest.mark.slow
+def test_interleaved_remat_parity():
+    assert_interleaved_parity(S=2, V=2, M=4, remat=True)
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("stage_local,remat", [(True, False), (False, True),
+                                               (True, True)])
+def test_interleaved_stage_local_remat_parity_s4(stage_local, remat):
+    assert_interleaved_parity(
+        S=4, V=2, M=8, stage_local=stage_local, remat=remat
+    )
+
+
+@pytest.mark.slow
+def test_interleaved_bn_trajectory_matches_grouped_gpipe():
+    """3-step trajectory with BatchNorm: the interleaved engine (S=2
+    devices × V=2 BN chunks) against a gpipe engine on the SAME mesh
+    whose stages are the same chunks grouped contiguously (stage i =
+    chunks 2i, 2i+1) with params/state TRANSPLANTED from the interleaved
+    init — same data-parallel width, same microbatch contents, so BN
+    batch-stat normalization and the m=0..M-1 running-stat fold order
+    must agree step for step (losses, BN state, and params together)."""
+    from distributed_model_parallel_tpu.parallel.data_parallel import (
+        TrainState,
+    )
+
+    def bn_chunk(cin, cout):
+        return L.sequential(
+            L.conv2d(cin, cout, 3, stride=1, padding=1),
+            L.batchnorm2d(cout),
+            L.relu(),
+        )
+
+    chunks = [
+        bn_chunk(3, 8), bn_chunk(8, 8), bn_chunk(8, 8),
+        L.sequential(
+            bn_chunk(8, 8), L.global_avg_pool(), L.linear(8, 4)
+        ),
+    ]
+    mesh = mesh_for(2)
+    images, labels = batch(seed=5)
+    eng_i = PipelineEngine(
+        chunks, SGD(momentum=0.9), mesh, num_microbatches=4,
+        donate=False, schedule="interleaved", virtual_stages=2,
+    )
+    grouped = [
+        L.sequential(chunks[0], chunks[1]),
+        L.sequential(chunks[2], chunks[3]),
+    ]
+    eng_g = PipelineEngine(
+        grouped, SGD(momentum=0.9), mesh, num_microbatches=4,
+        donate=False, schedule="gpipe",
+    )
+    ts_i = eng_i.init_state(jax.random.PRNGKey(3))
+    p = ts_i.params
+    st = ts_i.model_state
+    gp = ({"0": p[0], "1": p[1]}, {"0": p[2], "1": p[3]})
+    gs = ({"0": st[0], "1": st[1]}, {"0": st[2], "1": st[3]})
+    ts_g = jax.device_put(
+        TrainState(
+            gp, gs, eng_g.optimizer.init(gp), jnp.zeros((), jnp.int32)
+        ),
+        eng_g._repl,
+    )
+    out = {}
+    for name, (eng, ts) in (
+        ("interleaved", (eng_i, ts_i)), ("gpipe", (eng_g, ts_g))
+    ):
+        sb = eng.shard_batch(images, labels)
+        losses = []
+        for _ in range(3):
+            ts, m = eng.train_step(ts, *sb, jnp.float32(0.05))
+            losses.append(float(m["loss_sum"]) / float(m["count"]))
+        out[name] = (ts, losses)
+    np.testing.assert_allclose(
+        out["gpipe"][1], out["interleaved"][1], rtol=1e-5
+    )
+    for (path, a), b in zip(
+        jax.tree_util.tree_leaves_with_path(out["gpipe"][0].model_state),
+        jax.tree_util.tree_leaves(out["interleaved"][0].model_state),
+    ):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=1e-5, atol=1e-7,
+            err_msg=f"BN state {jax.tree_util.keystr(path)}",
+        )
+    for a, b in zip(
+        jax.tree_util.tree_leaves(out["gpipe"][0].params),
+        jax.tree_util.tree_leaves(out["interleaved"][0].params),
+    ):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=1e-4, atol=1e-6
+        )
+
+
+@pytest.mark.slow
+def test_interleaved_stage_local_checkpoint_canonical_roundtrip():
+    """The device-major row permutation (`staging.row_of_logical`) under
+    stage_local_params: to_canonical must yield the LOGICAL-order chunk
+    tuple (identical to the replicated engine's init from the same key),
+    from_canonical must invert it, and a canonical checkpoint written by
+    the stage-local engine must load into the replicated engine and
+    produce the identical next step."""
+    chunks = cnn_chunks(4)
+    mesh = mesh_for(2)
+    kw = dict(
+        num_microbatches=2, donate=False, schedule="interleaved",
+        virtual_stages=2,
+    )
+    loc = PipelineEngine(
+        chunks, SGD(momentum=0.9), mesh, stage_local_params=True, **kw
+    )
+    rep = PipelineEngine(chunks, SGD(momentum=0.9), mesh, **kw)
+    ts_l = loc.init_state(jax.random.PRNGKey(7))
+    canon = loc.to_canonical(ts_l)
+    ts_r = rep.init_state(jax.random.PRNGKey(7))
+    for a, b in zip(
+        jax.tree_util.tree_leaves(canon.params),
+        jax.tree_util.tree_leaves(ts_r.params),
+    ):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    ts_l2 = loc.from_canonical(canon)
+    for a, b in zip(
+        jax.tree_util.tree_leaves(loc.params_tree(ts_l)),
+        jax.tree_util.tree_leaves(loc.params_tree(ts_l2)),
+    ):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    images, labels = batch(n=16)
+    tl, _ = loc.train_step(
+        ts_l, *loc.shard_batch(images, labels), jnp.float32(0.1)
+    )
+    tr, _ = rep.train_step(
+        rep.from_canonical(canon), *rep.shard_batch(images, labels),
+        jnp.float32(0.1),
+    )
+    for a, b in zip(
+        jax.tree_util.tree_leaves(loc.params_tree(tl)),
+        jax.tree_util.tree_leaves(rep.params_tree(tr)),
+    ):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=1e-6, atol=1e-7
+        )
 
 
 def test_1f1b_bn_running_stats_match_gpipe():
@@ -418,8 +763,79 @@ def test_lm_pipeline_1f1b_matches_gpipe():
         )
 
 
+@pytest.mark.slow
+def test_lm_pipeline_interleaved_matches_gpipe():
+    """LM-head code paths under the interleaved schedule — integer
+    chunk-0 input, token-level (mb*T, vocab) rows on the LAST logical
+    chunk, per-microbatch label slices — pinned by a 2-step trajectory
+    against a gpipe engine running the same 4 chunks as 4 physical
+    stages (dropout 0: the schedules draw per-(logical chunk,
+    microbatch) keys on different meshes)."""
+    from distributed_model_parallel_tpu.models.gpt import (
+        GPTConfig,
+        split_stages,
+    )
+    from distributed_model_parallel_tpu.parallel.pipeline import (
+        LMPipelineEngine,
+    )
+
+    cfg = GPTConfig(
+        vocab_size=32, dim=16, num_layers=4, num_heads=2, ffn_dim=32,
+        max_position=16, dropout_rate=0.0, pad_token_id=0,
+    )
+    chunks = split_stages(4, cfg)
+    rng = np.random.RandomState(3)
+    ids = rng.randint(1, 32, size=(8, 16)).astype(np.int32)
+    out = {}
+    for name, (mesh, kw) in {
+        "interleaved": (mesh_for(2), dict(schedule="interleaved",
+                                          virtual_stages=2)),
+        "gpipe": (mesh_for(4), dict(schedule="gpipe")),
+    }.items():
+        engine = LMPipelineEngine(
+            chunks, SGD(momentum=0.9), mesh, num_microbatches=2,
+            donate=False, pad_token_id=0, **kw,
+        )
+        ts = engine.init_state(jax.random.PRNGKey(0))
+        sb = engine.shard_batch(ids)
+        losses = []
+        for _ in range(2):
+            ts, m = engine.train_step(ts, *sb, jnp.float32(0.05))
+            losses.append(float(m["loss_sum"]) / float(m["count"]))
+        out[name] = (engine.params_tree(ts), losses)
+    np.testing.assert_allclose(
+        out["gpipe"][1], out["interleaved"][1], rtol=1e-5
+    )
+    for (path, a), b in zip(
+        jax.tree_util.tree_leaves_with_path(out["gpipe"][0]),
+        jax.tree_util.tree_leaves(out["interleaved"][0]),
+    ):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=1e-4, atol=1e-6,
+            err_msg=jax.tree_util.keystr(path),
+        )
+
+
 def test_schedule_flag_validation():
     with pytest.raises(ValueError, match="schedule"):
         PipelineEngine(
-            cnn_stages(2), SGD(), mesh_for(2), schedule="interleaved"
+            cnn_stages(2), SGD(), mesh_for(2), schedule="pipedream"
+        )
+    # virtual_stages is an interleaved-only knob.
+    with pytest.raises(ValueError, match="virtual_stages"):
+        PipelineEngine(
+            cnn_stages(2), SGD(), mesh_for(2), schedule="1f1b",
+            virtual_stages=2,
+        )
+    # interleaved V=2 over S=2 devices needs 4 chunks, not 2.
+    with pytest.raises(ValueError, match="chunks"):
+        PipelineEngine(
+            cnn_stages(2), SGD(), mesh_for(2), schedule="interleaved",
+            virtual_stages=2,
+        )
+    # Megatron's M % S == 0 constraint surfaces at construction.
+    with pytest.raises(ValueError, match="divisible"):
+        PipelineEngine(
+            cnn_chunks(4), SGD(), mesh_for(2), num_microbatches=3,
+            schedule="interleaved", virtual_stages=2,
         )

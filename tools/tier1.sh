@@ -5,16 +5,23 @@
 #
 #   bash tools/tier1.sh            # from the repo root
 #
-# Behavior, kept bit-identical to the ROADMAP line:
+# Behavior, matching the ROADMAP line (the only additions are the
+# --durations flags, which append a report section pytest's dot
+# protocol and our DOTS_PASSED grep never see):
 #   * CPU-only jax (the conftest also forces it; the env var keeps the
 #     PJRT plugin from dialing the TPU relay at interpreter start),
-#   * the default marker filter (-m 'not slow', see pytest.ini),
+#   * the default marker filter (-m 'not slow', see pytest.ini) — the
+#     full S×V×M pipeline-schedule parity sweep is `slow`; tier-1 keeps
+#     its S=2,V=2,M=4 smoke case,
 #   * survives collection errors so one broken module can't hide the
 #     rest of the suite's result,
 #   * 870 s budget with a hard kill 10 s later,
 #   * DOTS_PASSED=<n> printed from the progress dots as a
 #     tamper-resistant pass count (parsed from the tee'd log, not from
 #     pytest's summary line),
+#   * a per-module slowest-10 durations digest (from pytest's
+#     --durations section) so a module creeping toward the 870 s budget
+#     is visible in every run, not just the ones that blow it,
 #   * exits with pytest's status (PIPESTATUS survives the tee).
 
 set -o pipefail
@@ -24,9 +31,36 @@ rm -f /tmp/_t1.log
 timeout -k 10 870 env JAX_PLATFORMS=cpu \
     python -m pytest tests/ -q -m 'not slow' \
     --continue-on-collection-errors \
+    --durations=0 --durations-min=0.5 \
     -p no:cacheprovider -p no:xdist -p no:randomly \
     2>&1 | tee /tmp/_t1.log
 rc=${PIPESTATUS[0]}
 echo DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' /tmp/_t1.log \
     | tr -cd . | wc -c)
+
+# Per-module slowest-10 digest from the durations section ("1.23s call
+# tests/test_x.py::test_y" lines). Purely informational: never changes rc.
+python - <<'PYEOF' || true
+import collections
+import re
+
+rows = collections.defaultdict(list)
+try:
+    with open("/tmp/_t1.log") as f:
+        for line in f:
+            m = re.match(
+                r"\s*([0-9.]+)s\s+call\s+(tests/[^:]+)::(\S+)", line
+            )
+            if m:
+                rows[m.group(2)].append((float(m.group(1)), m.group(3)))
+except OSError:
+    rows = {}
+for mod in sorted(rows, key=lambda k: -sum(s for s, _ in rows[k])):
+    top = sorted(rows[mod], reverse=True)[:10]
+    total = sum(s for s, _ in rows[mod])
+    print(f"[tier1-durations] {mod} ({total:.1f}s in >=0.5s tests) "
+          f"slowest-{len(top)}: "
+          + ", ".join(f"{name}={secs:.1f}s" for secs, name in top))
+PYEOF
+
 exit $rc
