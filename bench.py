@@ -62,6 +62,20 @@ PROBE_TIMEOUT_S = int(os.environ.get("BENCH_PROBE_TIMEOUT_S", "10"))
 PROBE_ATTEMPTS = 2
 PROBE_BACKOFF_S = 3.0
 
+# Dial watchdog for the HEADLINE child (round-5 regression, BENCH_r05):
+# the pre-probe proved the relay answers in seconds, yet the patient
+# measurement child could still burn its whole 390 s budget when the
+# relay wedged BETWEEN probe and measure — its inner SIGALRM never
+# fires inside non-GIL-releasing plugin code, and the parent's only
+# deadline was the full-budget kill. The parent now watches the child's
+# stderr for the "backend up" line; if the dial hasn't completed within
+# this bound the whole process group is killed immediately and the run
+# falls through to the CPU diagnostic with the probe's diagnosis in its
+# JSON. Probe (< 30 s worst case, seconds typically) + this watchdog
+# keeps a dead relay under the < 60 s contract.
+DIAL_WATCHDOG_S = int(os.environ.get("BENCH_DIAL_WATCHDOG_S", "45"))
+DIAL_MARKER = "backend up"
+
 # Peak bf16 matmul TFLOP/s per chip by TPU generation (public numbers);
 # MFU is measured FLOP/s divided by this. Unknown kinds report mfu: null.
 PEAK_BF16_TFLOPS = {
@@ -664,6 +678,162 @@ def run_child_cm(max_devices: int, platform: str = "cpu") -> None:
     print(json.dumps(out, indent=2))
 
 
+def run_child_reducer(max_devices: int, platform: str = "cpu") -> None:
+    """Naive-vs-bucketed-vs-hierarchical gradient-reduction microbench
+    (`ops/grad_reduction.py`) — the reducer counterpart of the
+    collective-matmul table.
+
+    For each data-parallel size S, times the mean-reduction of a
+    ResNet-spread gradient pytree in three lowerings:
+      * naive        — per-leaf `lax.pmean` over the flat data axis
+                       (the unfused many-small-all-reduces shape this
+                       backend lowers ResNet-50's DDP step to,
+                       experiments/scaling64.py step 2);
+      * bucketed     — dtype-grouped ~bucket_mb flat buckets, each a
+                       chunked ppermute ring (reduce-scatter +
+                       all-gather), single fabric;
+      * hierarchical — the same buckets over a 2×(S/2) dcn×ici mesh:
+                       ring reduce-scatter over 'ici', one cross-slice
+                       all-reduce on the 1/S shard over 'dcn', ring
+                       all-gather back.
+    Emits one partial JSON line per completed size (a wedge mid-sweep
+    keeps the finished legs), then the table. Meaningful on a real
+    slice; on virtual CPU devices the rings serialize onto one core
+    (the note in the JSON says so)."""
+    if max_devices < 2:
+        raise ValueError(f"--max-devices must be >= 2, got {max_devices}")
+    if platform == "cpu":
+        from distributed_model_parallel_tpu.runtime.platform import force_cpu
+
+        force_cpu(max_devices)
+
+    from functools import partial
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax import lax
+    from jax.sharding import Mesh, PartitionSpec as P
+
+    from distributed_model_parallel_tpu.ops.grad_reduction import (
+        bucketed_pmean,
+        plan_buckets,
+    )
+    from distributed_model_parallel_tpu.runtime.compat import shard_map
+
+    devices = jax.devices("cpu") if platform == "cpu" else jax.devices()
+    sizes = []
+    n = 2
+    while n <= min(max_devices, len(devices)):
+        sizes.append(n)
+        n *= 2
+
+    # A ResNet-ish spread of gradient leaves (conv kernels, BN scales,
+    # a head) totaling a few MB — enough for several 1 MB buckets
+    # without drowning the CPU harness.
+    rng = np.random.RandomState(0)
+    shapes = (
+        [(3, 3, 64, 64)] * 8 + [(1, 1, 256, 64)] * 4
+        + [(512, 10)] + [(64,)] * 40 + [(256,)] * 20
+    )
+    grads = {
+        f"g{i}": jnp.asarray(0.01 * rng.randn(*s), jnp.float32)
+        for i, s in enumerate(shapes)
+    }
+    bucket_mb = 1.0
+    n_bytes = sum(int(np.prod(s)) * 4 for s in shapes)
+    n_buckets = len(
+        plan_buckets(jax.tree_util.tree_leaves(grads), bucket_mb)
+    )
+
+    def fence(out):
+        # Value-fetch barrier over EVERY leaf (see _sync): the naive
+        # variant is 73 independent per-leaf reductions and the
+        # bucketed ones several buckets — fetching one leaf would stop
+        # the clock with most of the work still in flight on the
+        # tunneled backend.
+        _ = jax.device_get(jnp.stack(
+            [l.ravel()[0] for l in jax.tree_util.tree_leaves(out)]
+        ))
+
+    def time_fn(fn, iters=10):
+        fence(fn(grads))  # compile + warmup
+        t0 = time.perf_counter()
+        for _i in range(iters):
+            out = fn(grads)
+        fence(out)
+        return (time.perf_counter() - t0) / iters * 1e3
+
+    def reducer(mesh, fn):
+        spec = jax.tree_util.tree_map(lambda _: P(), grads)
+        return jax.jit(shard_map(
+            fn, mesh=mesh, in_specs=(spec,), out_specs=spec,
+            check_vma=False,
+        ))
+
+    rows = []
+    for size in sizes:
+        flat_mesh = Mesh(np.array(devices[:size]), ("data",))
+        naive = reducer(
+            flat_mesh,
+            lambda t: jax.tree_util.tree_map(
+                lambda g: lax.pmean(g, "data"), t
+            ),
+        )
+        bucketed = reducer(
+            flat_mesh,
+            partial(bucketed_pmean, ici_axis="data",
+                    bucket_mb=bucket_mb),
+        )
+        hier_mesh = Mesh(
+            np.array(devices[:size]).reshape(2, size // 2),
+            ("dcn", "ici"),
+        )
+        hierarchical = reducer(
+            hier_mesh,
+            partial(bucketed_pmean, ici_axis="ici", dcn_axis="dcn",
+                    bucket_mb=bucket_mb),
+        )
+        row = {
+            "axis_size": size,
+            "naive_ms": round(time_fn(naive), 3),
+            "bucketed_ms": round(time_fn(bucketed), 3),
+            "hierarchical_ms": round(time_fn(hierarchical), 3),
+        }
+        row["bucketed_speedup"] = round(
+            row["naive_ms"] / max(row["bucketed_ms"], 1e-9), 3
+        )
+        row["hierarchical_speedup"] = round(
+            row["naive_ms"] / max(row["hierarchical_ms"], 1e-9), 3
+        )
+        rows.append(row)
+        log(f"S={size}: naive {row['naive_ms']}ms, bucketed "
+            f"{row['bucketed_ms']}ms, hierarchical "
+            f"{row['hierarchical_ms']}ms")
+        # Per-leg partial line (same convention as the other sweeps).
+        print(json.dumps({"leg": row, "partial": True}), flush=True)
+
+    out = {
+        "reducer_microbench": rows,
+        "platform": jax.devices()[0].platform,
+        "device_kind": jax.devices()[0].device_kind,
+        "grad_mb": round(n_bytes / 1e6, 2),
+        "n_leaves": len(shapes),
+        "bucket_mb": bucket_mb,
+        "n_buckets": n_buckets,
+        "hierarchy": "2 x S/2 (dcn x ici)",
+    }
+    if jax.devices()[0].platform == "cpu":
+        out["note"] = (
+            "virtual CPU devices serialize the rings onto one core, so "
+            "bucket overlap cannot win here; the harness is meaningful "
+            "on a real slice, where per-bucket hops run beside the "
+            "remaining backward and the dcn all-reduce crosses the "
+            "slow fabric with 1/S of the bytes"
+        )
+    print(json.dumps(out, indent=2))
+
+
 # -------------------------------------------------------------- parent side
 
 
@@ -687,22 +857,95 @@ def _cpu_child_env(n_devices: int = 8) -> dict:
     return env
 
 
-def _kill_child() -> None:
-    global _current_child
-    if _current_child is not None and _current_child.poll() is None:
+def _kill_group(child) -> None:
+    """Kill a child's whole process group (children are spawned with
+    start_new_session=True, so pgid == pid)."""
+    if child is not None and child.poll() is None:
         try:
-            os.killpg(_current_child.pid, signal.SIGKILL)
+            os.killpg(child.pid, signal.SIGKILL)
         except (ProcessLookupError, PermissionError):
             pass
+
+
+def _kill_child() -> None:
+    global _current_child
+    _kill_group(_current_child)
     _current_child = None
 
 
-def _spawn(args: list[str], timeout_s: float, env=None):
+def _watch_child(child, timeout_s: float, dial_timeout_s=None,
+                 dial_marker: str = DIAL_MARKER):
+    """Wait on a bench child, streaming its pipes into memory, with an
+    optional DIAL watchdog: when `dial_timeout_s` is set and the child's
+    stderr has not carried `dial_marker` (the "backend up in Xs" line
+    `run_child` logs right after jax.devices() returns) by that bound,
+    the whole process group is killed THEN — a wedged relay dial cannot
+    consume the full measurement budget (BENCH_r05). Returns
+    (rc, stdout, stderr) with rc None on either kill; the streamed
+    output survives, so per-leg partial lines stay rescuable."""
+    import threading
+
+    out_parts: list[str] = []
+    err_parts: list[str] = []
+    dialed = threading.Event()
+
+    def reader(stream, parts, watch):
+        for line in iter(stream.readline, ""):
+            parts.append(line)
+            if watch and dial_marker in line:
+                dialed.set()
+        stream.close()
+
+    t_out = threading.Thread(
+        target=reader, args=(child.stdout, out_parts, False), daemon=True
+    )
+    t_err = threading.Thread(
+        target=reader, args=(child.stderr, err_parts, True), daemon=True
+    )
+    t_out.start()
+    t_err.start()
+    start = time.monotonic()
+    deadline = start + max(timeout_s, 10)
+    killed_note = None
+    while True:
+        rc = child.poll()
+        if rc is not None:
+            break
+        now = time.monotonic()
+        if (
+            dial_timeout_s is not None
+            and not dialed.is_set()
+            and now >= start + dial_timeout_s
+        ):
+            _kill_group(child)
+            killed_note = (
+                f"child killed by {dial_timeout_s:.0f}s dial watchdog "
+                f"— {dial_marker!r} never appeared on stderr; backend "
+                "dial wedged"
+            )
+            break
+        if now >= deadline:
+            _kill_group(child)
+            killed_note = f"child killed after {timeout_s:.0f}s timeout"
+            break
+        time.sleep(0.2)
+    t_out.join(timeout=10)
+    t_err.join(timeout=10)
+    out, err = "".join(out_parts), "".join(err_parts)
+    if killed_note is not None:
+        return None, out, (err + "\n" if err else "") + killed_note
+    return rc, out, err
+
+
+def _spawn(args: list[str], timeout_s: float, env=None,
+           dial_timeout_s=None):
     """Run a bench child in its own process group, killing the whole group
     on timeout (a plain subprocess timeout leaves grandchildren holding
-    the TPU). Returns (rc, stdout, stderr) with rc None on timeout; on
-    timeout the pipes are drained so whatever progress the child DID
-    stream ends up in the diagnostic JSON."""
+    the TPU). Returns (rc, stdout, stderr) with rc None on a kill —
+    overall timeout or, when `dial_timeout_s` is given, the dial
+    watchdog (`_watch_child`); the pipes are streamed continuously so
+    whatever progress the child DID write ends up in the diagnostic
+    JSON."""
     global _current_child
     child = subprocess.Popen(
         [sys.executable, os.path.abspath(__file__), *args],
@@ -710,18 +953,10 @@ def _spawn(args: list[str], timeout_s: float, env=None):
         start_new_session=True, env=env,
     )
     _current_child = child
-    try:
-        out, err = child.communicate(timeout=max(timeout_s, 10))
+    rc, out, err = _watch_child(child, timeout_s, dial_timeout_s)
+    if rc is not None:
         _current_child = None
-        return child.returncode, out, err
-    except subprocess.TimeoutExpired:
-        _kill_child()
-        try:  # drain the partial output the child wrote before the kill
-            out, err = child.communicate(timeout=10)
-        except Exception:  # noqa: BLE001
-            out, err = "", ""
-        note = f"child killed after {timeout_s:.0f}s timeout"
-        return None, out, (err + "\n" if err else "") + note
+    return rc, out, err
 
 
 def _json_line(stdout: str):
@@ -829,13 +1064,25 @@ def main() -> None:
             accel_err = accel_err or "no budget left for accelerator child"
             break
         attempts += 1
+        # Honor the pre-probe's verdict: it just round-tripped bytes in
+        # `dial_s` seconds, so the measurement child's DIAL gets a tight
+        # parent-enforced bound (not the old 180 s inner alarm that a
+        # non-GIL-releasing hang sails past, BENCH_r05) — a relay that
+        # wedges between probe and measure now costs this watchdog, not
+        # the round.
+        dial_budget = min(DIAL_WATCHDOG_S, max(accel_timeout - 30, 15))
+        child_env = dict(os.environ)
+        child_env["BENCH_DIAL_TIMEOUT_S"] = str(
+            max(int(dial_budget) - 5, 10)
+        )
         log(f"accelerator child (attempt {attempts}) gets "
-            f"{accel_timeout:.0f}s")
+            f"{accel_timeout:.0f}s (dial watchdog {dial_budget:.0f}s; "
+            f"probe dialed in {probe.get('dial_s')}s)")
         t_child = time.monotonic()
         rc, out, err = _spawn(
             ["--child", "--child-model", "mobilenetv2",
              "--child-batch", "512", "--child-dtypes", "bfloat16,float32"],
-            accel_timeout,
+            accel_timeout, env=child_env, dial_timeout_s=dial_budget,
         )
         child_secs = time.monotonic() - t_child
         line = _json_line(out)
@@ -866,7 +1113,9 @@ def main() -> None:
             log(accel_err)
         else:
             accel_err = (err or out)[-300:].strip()
-            if rc is None and not out:
+            if rc is None and not out and "dial watchdog" not in (
+                err or ""
+            ):
                 where = (
                     "during the backend dial (jax.devices)"
                     if "initializing backend" in (err or "")
@@ -877,12 +1126,22 @@ def main() -> None:
                 )
             log(f"accelerator child failed (rc={rc}): {accel_err}")
         # Retry once on a FAST failure (crash or quick cpu degrade — a
-        # transient); a timed-out child already consumed its patience.
+        # transient); a killed child (dial watchdog or overall timeout,
+        # rc None) already consumed its patience budget — no retry.
         fast_failure = rc is not None and child_secs < 60
         if not (fast_failure and attempts < 2):
             break
         log("fast failure; retrying once")
 
+    # The probe's diagnosis travels into the round's JSON — but only
+    # when the measurement child actually ran and failed (the relay
+    # answered the 1 KB fetch, then something broke); a "no budget
+    # left" break must not be mislabeled as a relay wedge.
+    if probe and attempts:
+        accel_err += (
+            f" [pre-probe had answered: {probe.get('n_chips')}x "
+            f"{probe.get('device_kind')} in {probe.get('dial_s')}s]"
+        )
     _cpu_fallback(remaining, accel_err)
 
 
@@ -948,6 +1207,13 @@ if __name__ == "__main__":
              "--scaling-platform / --max-devices",
     )
     parser.add_argument(
+        "--reducer-microbench", action="store_true",
+        help="print a naive-vs-bucketed-vs-hierarchical gradient-"
+             "reduction table (DDP-Reducer flat buckets over dcn×ici, "
+             "ops/grad_reduction.py) instead of the single benchmark "
+             "line; devices from --scaling-platform / --max-devices",
+    )
+    parser.add_argument(
         "--child", action="store_true",
         help="internal: run a measurement in-process (spawned by main)",
     )
@@ -960,6 +1226,9 @@ if __name__ == "__main__":
     parser.add_argument("--child-cm", action="store_true",
                         help="internal: run the collective-matmul "
                              "microbench in-process")
+    parser.add_argument("--child-reducer", action="store_true",
+                        help="internal: run the gradient-reduction "
+                             "microbench in-process")
     parser.add_argument("--child-model", default="mobilenetv2")
     parser.add_argument("--child-batch", type=int, default=512)
     parser.add_argument("--child-dtypes", default="bfloat16,float32")
@@ -967,11 +1236,14 @@ if __name__ == "__main__":
                         help="internal: force the virtual-CPU mesh")
     args = parser.parse_args()
 
-    if args.scaling and args.cm_microbench:
+    n_sweeps = sum(
+        (args.scaling, args.cm_microbench, args.reducer_microbench)
+    )
+    if n_sweeps > 1:
         parser.error(
-            "--scaling and --cm-microbench are mutually exclusive "
-            "(one sweep per invocation; running both would silently "
-            "drop one table)"
+            "--scaling / --cm-microbench / --reducer-microbench are "
+            "mutually exclusive (one sweep per invocation; running "
+            "several would silently drop tables)"
         )
 
     if args.child_probe:
@@ -988,6 +1260,9 @@ if __name__ == "__main__":
     if args.child_cm:
         run_child_cm(args.max_devices, args.scaling_platform)
         sys.exit(0)
+    if args.child_reducer:
+        run_child_reducer(args.max_devices, args.scaling_platform)
+        sys.exit(0)
 
     def on_alarm(signum, frame):
         # Final backstop above the deadline bookkeeping: kill the child's
@@ -1001,7 +1276,7 @@ if __name__ == "__main__":
     signal.signal(signal.SIGALRM, on_alarm)
     signal.alarm(TOTAL_BUDGET_S + 30)
     try:
-        if args.scaling or args.cm_microbench:
+        if n_sweeps:
             env = (
                 _cpu_child_env(args.max_devices)
                 if args.scaling_platform == "cpu" else None
@@ -1014,12 +1289,19 @@ if __name__ == "__main__":
                      "--scaling-platform", args.scaling_platform],
                     env, "scaling",
                 )
-            else:
+            elif args.cm_microbench:
                 _run_sweep_child(
                     ["--child-cm",
                      "--max-devices", str(args.max_devices),
                      "--scaling-platform", args.scaling_platform],
                     env, "collective_matmul_microbench",
+                )
+            else:
+                _run_sweep_child(
+                    ["--child-reducer",
+                     "--max-devices", str(args.max_devices),
+                     "--scaling-platform", args.scaling_platform],
+                    env, "reducer_microbench",
                 )
         else:
             main()

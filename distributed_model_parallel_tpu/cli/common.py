@@ -260,13 +260,20 @@ def check_batch_divisibility(
 ) -> None:
     """Fail at startup (not at trace time, possibly an epoch in) when the
     batch cannot be laid out on the mesh: the global batch shards over the
-    'data' axis, and each device's shard must split into `microbatches`
-    equal microbatches for the pipeline schedule."""
-    data_axis = mesh.shape["data"]
+    data axes (the 'data' axis, or 'dcn'×'ici' on a hybrid mesh), and
+    each device's shard must split into `microbatches` equal microbatches
+    for the pipeline schedule."""
+    from distributed_model_parallel_tpu.runtime.mesh import (
+        data_axis_names,
+        data_axis_size,
+    )
+
+    axes = "x".join(f"'{a}'" for a in data_axis_names(mesh))
+    data_axis = data_axis_size(mesh)
     if global_batch % data_axis:
         raise SystemExit(
-            f"{label} size {global_batch} must be divisible by the 'data' "
-            f"mesh axis ({data_axis} shards)"
+            f"{label} size {global_batch} must be divisible by the "
+            f"{axes} mesh axes ({data_axis} shards)"
         )
     local = global_batch // data_axis
     if local % microbatches:
@@ -313,6 +320,63 @@ def check_pipeline_schedule_args(
                 f"S={num_stages}) — Megatron's round-robin microbatch "
                 f"groups"
             )
+
+
+def add_grad_reduction_flags(parser: argparse.ArgumentParser) -> None:
+    """The bucketed-reducer surface shared by the data_parallel and lm
+    CLIs (`ops/grad_reduction.py`)."""
+    parser.add_argument(
+        "--grad-reduction", default="monolithic",
+        choices=("monolithic", "bucketed"),
+        help="gradient reduction lowering: monolithic = one fused "
+             "all-reduce of the whole grad pytree (the GSPMD default); "
+             "bucketed = DDP-Reducer-style ~--bucket-mb flat buckets in "
+             "reverse parameter order, each a chunked ppermute "
+             "reduce-scatter/all-gather ring that interleaves with the "
+             "remaining backward — hierarchical over a --dcn-slices "
+             "factored mesh (same math)",
+    )
+    # None sentinel = "flag not passed": check_grad_reduction_args can
+    # then reject an explicit --bucket-mb without bucketed mode (any
+    # value, including 25) and resolves the default itself — one place
+    # owns the number.
+    parser.add_argument(
+        "--bucket-mb", default=None, type=float,
+        help="flat-buffer bucket size in MB under --grad-reduction "
+             "bucketed (the Reducer's bucket_cap_mb; default 25)",
+    )
+    parser.add_argument(
+        "--dcn-slices", default=1, type=int,
+        help="cross-slice (DCN) factor of the data axis: the mesh "
+             "carries ('dcn', 'ici') in place of 'data' so collectives "
+             "can address the two fabrics separately (bucketed "
+             "reduction then reduce-scatters over the intra-slice ring "
+             "and all-reduces only the 1/N shard across slices). On a "
+             "single process this is a virtual split",
+    )
+
+
+def check_grad_reduction_args(args) -> None:
+    """Startup-time validation of the shared reducer flags: fail with
+    CLI vocabulary before datasets/meshes are built. Resolves the
+    `--bucket-mb` None sentinel to the 25 MB default afterward."""
+    if args.bucket_mb is not None:
+        if args.bucket_mb <= 0:
+            raise SystemExit(
+                f"--bucket-mb must be > 0, got {args.bucket_mb}"
+            )
+        if args.grad_reduction != "bucketed":
+            raise SystemExit(
+                "--bucket-mb sizes the bucketed reducer's flat "
+                "buffers; it only applies under --grad-reduction "
+                "bucketed"
+            )
+    else:
+        args.bucket_mb = 25.0
+    if args.dcn_slices < 1:
+        raise SystemExit(
+            f"--dcn-slices must be >= 1, got {args.dcn_slices}"
+        )
 
 
 def compute_dtype_from_flag(name: str):

@@ -26,10 +26,12 @@ import jax
 
 from distributed_model_parallel_tpu.cli.common import (
     add_common_tpu_flags,
+    add_grad_reduction_flags,
     build_loaders,
     build_model,
     build_optimizer,
     check_batch_divisibility,
+    check_grad_reduction_args,
     compute_dtype_from_flag,
 )
 from distributed_model_parallel_tpu.parallel.data_parallel import (
@@ -91,6 +93,7 @@ def build_parser() -> argparse.ArgumentParser:
                              "of the partitioner's monolithic "
                              "all-gather/reduce-scatter (same math; "
                              "transformer-family models)")
+    add_grad_reduction_flags(parser)
     parser.add_argument("--max-restarts", default=0, type=int,
                         help="fail-fast elastic mode: restart from the "
                              "per-epoch checkpoint up to N times on "
@@ -130,6 +133,21 @@ def main(argv=None) -> dict:
             )
         if not os.path.exists(args.finetune):
             raise SystemExit(f"--finetune: no such file {args.finetune!r}")
+    check_grad_reduction_args(args)
+    if args.grad_reduction == "bucketed" and args.engine not in (
+        "ddp", "fsdp"
+    ):
+        raise SystemExit(
+            "--grad-reduction bucketed replaces the explicit gradient "
+            "collective of the shard_map engines (ddp, fsdp); the "
+            f"declarative --engine {args.engine} step has no explicit "
+            "reduction site to bucket"
+        )
+    if args.engine == "tp" and args.dcn_slices != 1:
+        raise SystemExit(
+            "--dcn-slices factors the data axis for the hierarchical "
+            "reducer; combine it with --engine gspmd/ddp/fsdp, not tp"
+        )
     if args.engine != "tp":
         if args.model_shards != 1:
             raise SystemExit(
@@ -170,7 +188,7 @@ def main(argv=None) -> dict:
     if args.engine == "tp":
         mesh = make_mesh(MeshSpec(data=-1, model=args.model_shards))
     else:
-        mesh = make_mesh(MeshSpec(data=-1))
+        mesh = make_mesh(MeshSpec(data=-1, dcn=args.dcn_slices))
     check_batch_divisibility(args.batch_size, mesh)
     check_batch_divisibility(args.val_batch_size, mesh, label="val batch")
     if args.dataset_type == "SyntheticText" and (
@@ -217,12 +235,16 @@ def main(argv=None) -> dict:
         engine = DDPEngine(
             model, opt, mesh, sync_bn=args.sync_bn, compute_dtype=cdt,
             input_transform=itf,
+            grad_reduction=args.grad_reduction,
+            bucket_mb=args.bucket_mb,
         )
     elif args.engine == "fsdp":
         from distributed_model_parallel_tpu.parallel.fsdp import FSDPEngine
 
         engine = FSDPEngine(
-            model, opt, mesh, compute_dtype=cdt, input_transform=itf
+            model, opt, mesh, compute_dtype=cdt, input_transform=itf,
+            grad_reduction=args.grad_reduction,
+            bucket_mb=args.bucket_mb,
         )
     elif args.engine == "tp":
         from distributed_model_parallel_tpu.parallel.tensor_parallel import (

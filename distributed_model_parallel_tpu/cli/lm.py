@@ -28,8 +28,10 @@ import argparse
 import jax
 
 from distributed_model_parallel_tpu.cli.common import (
+    add_grad_reduction_flags,
     build_optimizer,
     check_batch_divisibility,
+    check_grad_reduction_args,
     check_pipeline_schedule_args,
     compute_dtype_from_flag,
 )
@@ -106,6 +108,7 @@ def build_parser() -> argparse.ArgumentParser:
                         "overlaps the partial dot already on hand "
                         "(same math; requires --ffn-dim divisible by "
                         "--seq-shards)")
+    add_grad_reduction_flags(p)
     p.add_argument("--dtype", default="float32",
                    choices=("float32", "bfloat16"))
     p.add_argument("--remat", action="store_true")
@@ -168,6 +171,16 @@ def main(argv=None) -> dict:
         raise SystemExit(
             f"--microbatches must be >= 1, got {args.microbatches}"
         )
+    check_grad_reduction_args(args)
+    if args.pipeline_stages > 1 and (
+        args.grad_reduction != "monolithic" or args.dcn_slices != 1
+    ):
+        raise SystemExit(
+            "--grad-reduction bucketed / --dcn-slices address the "
+            "sequence-parallel engine's data-axis gradient collective; "
+            "the pipeline engine reduces over 'stage' wires — drop the "
+            "flags or --pipeline-stages"
+        )
     if args.pipeline_stages > 1:
         check_pipeline_schedule_args(
             args.pipeline_schedule, args.virtual_stages,
@@ -187,7 +200,9 @@ def main(argv=None) -> dict:
             args.batch_size, mesh, microbatches=args.microbatches
         )
     else:
-        mesh = make_mesh(MeshSpec(data=-1, seq=args.seq_shards))
+        mesh = make_mesh(MeshSpec(
+            data=-1, seq=args.seq_shards, dcn=args.dcn_slices,
+        ))
         check_batch_divisibility(args.batch_size, mesh)
     if args.seq_len % args.seq_shards:
         raise SystemExit(
@@ -227,6 +242,8 @@ def main(argv=None) -> dict:
             compute_dtype=compute_dtype_from_flag(args.dtype),
             remat=args.remat,
             collective_matmul=args.collective_matmul,
+            grad_reduction=args.grad_reduction,
+            bucket_mb=args.bucket_mb,
         )
     corpus = synthetic_corpus(
         args.vocab_size, args.corpus_tokens, seed=args.corpus_seed

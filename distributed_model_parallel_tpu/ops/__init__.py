@@ -5,7 +5,9 @@ all-to-all, and ring_flash_attention — the ring with the fused Pallas
 kernels as its per-hop core), the Pallas flash-attention kernels
 (forward + backward) for the single-chip hot path, and the
 latency-hiding collective matmuls (chunked ppermute ag_matmul /
-matmul_rs for the TP/SP projection layers)."""
+matmul_rs for the TP/SP projection layers), and the bucketed
+hierarchy-aware gradient reducer (flat-buffer buckets over dcn×ici —
+the DDP Reducer re-expressed, `grad_reduction.py`)."""
 
 from distributed_model_parallel_tpu.ops.attention import (  # noqa: F401
     dot_product_attention,
@@ -15,6 +17,13 @@ from distributed_model_parallel_tpu.ops.collective_matmul import (  # noqa: F401
     LocalCollectiveMatmul,
     ag_matmul,
     matmul_rs,
+)
+from distributed_model_parallel_tpu.ops.grad_reduction import (  # noqa: F401
+    bucketed_pmean,
+    bucketed_psum,
+    plan_buckets,
+    ring_all_gather,
+    ring_reduce_scatter,
 )
 from distributed_model_parallel_tpu.ops.pallas_attention import (  # noqa: F401
     flash_attention,

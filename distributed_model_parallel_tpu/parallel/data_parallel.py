@@ -23,6 +23,16 @@ declarative equivalent of DDP's ring all-reduce, kept for (a) per-replica
 BatchNorm semantics faithful to `nn.DataParallel` (no SyncBN in reference
 code), and (b) showing the collective structure explicitly, which also
 gives XLA a single fused reduction instead of per-bucket ops.
+`grad_reduction="bucketed"` swaps that monolithic pmean for the
+Reducer-faithful path (`ops/grad_reduction.py`): ~`bucket_mb` flat
+buckets in reverse registration order, each reduced as chunked ppermute
+rings — hierarchically (reduce-scatter over 'ici', cross-slice
+all-reduce over 'dcn' on the 1/N shard, all-gather back) when the mesh
+is a hybrid `MeshSpec(dcn=K)` one.
+
+Both engines run on either mesh family: the data-parallel world is
+`data_axis_names(mesh)` — ('data',) on a plain mesh, ('dcn', 'ici') on
+a hybrid one — everywhere a batch is sharded or a gradient reduced.
 
 Both engines produce bit-comparable training trajectories when BN modes
 match (tested on the 8-device CPU mesh).
@@ -41,6 +51,14 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from distributed_model_parallel_tpu.runtime.compat import shard_map
 
 from distributed_model_parallel_tpu.models.layers import Context, Layer
+from distributed_model_parallel_tpu.ops.grad_reduction import (
+    bucketed_pmean,
+    data_replica_index,
+)
+from distributed_model_parallel_tpu.runtime.mesh import (
+    data_axis_names,
+    data_hierarchy_axes,
+)
 from distributed_model_parallel_tpu.training.metrics import (
     cross_entropy,
     topk_correct,
@@ -156,7 +174,7 @@ class DataParallelEngine:
     def __post_init__(self):
         mesh = self.mesh
         self._repl = NamedSharding(mesh, P())
-        self._batch = NamedSharding(mesh, P(("data",)))
+        self._batch = NamedSharding(mesh, P(data_axis_names(mesh)))
         cdt = self.compute_dtype
         tf = self.input_transform
         model = self.model
@@ -249,30 +267,47 @@ class DDPEngine:
     donate: bool = True
     compute_dtype: Any = None  # see DataParallelEngine
     input_transform: Any = None  # see DataParallelEngine
+    # "monolithic": one fused pmean of the whole grad pytree (default —
+    # the single-collective lowering). "bucketed": the DDP-Reducer path
+    # (`ops/grad_reduction.py`) — `bucket_mb` flat buckets in reverse
+    # registration order, each a chunked-ppermute ring reduce-scatter/
+    # all-gather over the intra-slice fabric with a single cross-slice
+    # all-reduce on the 1/N shard when the mesh carries a 'dcn' factor.
+    # Same math (parity pinned at rtol 1e-5, tests/test_grad_reduction).
+    grad_reduction: str = "monolithic"
+    bucket_mb: float = 25.0
 
     def __post_init__(self):
+        if self.grad_reduction not in ("monolithic", "bucketed"):
+            raise ValueError(
+                "grad_reduction must be 'monolithic' or 'bucketed', "
+                f"got {self.grad_reduction!r}"
+            )
         mesh = self.mesh
+        d_axes, ici_axis, dcn_axis = data_hierarchy_axes(mesh)
         self._repl = NamedSharding(mesh, P())
-        self._batch = NamedSharding(mesh, P(("data",)))
-        bn_axis = "data" if self.sync_bn else None
+        self._batch = NamedSharding(mesh, P(d_axes))
+        bn_axis = d_axes if self.sync_bn else None
         cdt = self.compute_dtype
         tf = self.input_transform
         model = self.model
+        bucketed = self.grad_reduction == "bucketed"
+        bucket_mb = self.bucket_mb
 
         @partial(
             shard_map,
             mesh=mesh,
-            in_specs=(P(), P(("data",)), P(("data",)), P()),
+            in_specs=(P(), P(d_axes), P(d_axes), P()),
             out_specs=(P(), P()),
             check_vma=False,
         )
         def shard_step(ts: TrainState, images, labels, lr):
-            # Per-shard dropout key: fold in the data-axis index so every
-            # replica draws independent masks (per-replica semantics, like
-            # the reference's per-device threads).
+            # Per-shard dropout key: fold in the data-replica index so
+            # every replica draws independent masks (per-replica
+            # semantics, like the reference's per-device threads).
             rng = jax.random.fold_in(
                 jax.random.fold_in(jax.random.PRNGKey(0), ts.step),
-                lax.axis_index("data"),
+                data_replica_index(d_axes),
             )
 
             images_c = _cast_input(
@@ -291,24 +326,33 @@ class DDPEngine:
                 loss_fn, has_aux=True
             )(ts.params, ts.model_state)
             loss = ce
-            # THE all-reduce: mean-over-global-batch gradient in one fused
-            # collective over ICI (replaces Reducer buckets + NCCL ring).
-            grads = lax.pmean(grads, "data")
+            if bucketed:
+                # The Reducer path: per-bucket rings, hierarchical over
+                # a dcn×ici mesh (`ops/grad_reduction.py` docstring).
+                grads = bucketed_pmean(
+                    grads, ici_axis, dcn_axis, bucket_mb=bucket_mb
+                )
+            else:
+                # THE all-reduce: mean-over-global-batch gradient in one
+                # fused collective (replaces Reducer buckets + NCCL ring).
+                grads = lax.pmean(grads, d_axes)
             if not self.sync_bn:
                 # Deterministic persisted stats (see class docstring).
-                new_state = lax.pmean(new_state, "data")
+                new_state = lax.pmean(new_state, d_axes)
             params, opt_state = self.optimizer.update(
                 ts.params, ts.opt_state, grads, lr
             )
             new_ts = TrainState(params, new_state, opt_state, ts.step + 1)
             m = _metrics(loss, logits, labels)
-            m = jax.tree_util.tree_map(lambda v: lax.psum(v, "data"), m)
+            m = jax.tree_util.tree_map(
+                lambda v: lax.psum(v, d_axes), m
+            )
             return new_ts, m
 
         @partial(
             shard_map,
             mesh=mesh,
-            in_specs=(P(), P(("data",)), P(("data",))),
+            in_specs=(P(), P(d_axes), P(d_axes)),
             out_specs=P(),
             check_vma=False,
         )
@@ -322,7 +366,9 @@ class DDPEngine:
             )
             loss = cross_entropy(logits, labels)
             m = _metrics(loss, logits, labels)
-            return jax.tree_util.tree_map(lambda v: lax.psum(v, "data"), m)
+            return jax.tree_util.tree_map(
+                lambda v: lax.psum(v, d_axes), m
+            )
 
         donate = (0,) if self.donate else ()
         self.train_step = jax.jit(shard_step, donate_argnums=donate)

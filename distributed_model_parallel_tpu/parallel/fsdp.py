@@ -15,6 +15,24 @@ pinned in tests/test_fsdp.py).
 Tiny leaves (BN/LN scales, biases below `min_shard_elems`) stay
 replicated: sharding them saves nothing and costs a collective each.
 
+`grad_reduction="bucketed"` swaps the declarative jit step for an
+EXPLICIT shard_map program — the bucketed-reduce-scatter twin of
+`DDPEngine(grad_reduction="bucketed")`: parameters stay stored 1/N
+(same `fsdp_specs` layout, checkpoints interoperate), each sharded
+leaf is all-gathered on entry, and the gradient pytree is reduced
+through the Reducer-style flat buckets of `ops/grad_reduction.py` —
+per-bucket chunked-ppermute reduce-scatter over the intra-slice 'ici'
+fabric, one cross-slice all-reduce on the 1/S shard over 'dcn', ring
+all-gather back — after which every device slices ITS OWN 1/N shard of
+each leaf locally and updates its parameter/moment shards in place.
+The bucket all-gather half is shared with the DDP reducer (a flat 1/N
+bucket shard cannot be re-dealt into per-dimension leaf shards without
+an equal-volume redistribution, so reusing the overlapped ring costs
+nothing extra); the at-rest memory story — params and moments 1/N —
+is unchanged. BatchNorm runs in SyncBN mode (global batch statistics),
+matching the declarative engine's semantics; parity at rtol 1e-5 is
+pinned in tests/test_grad_reduction.py.
+
 Compose with the other axes by SUBCLASSING and overriding
 `param_specs` (e.g. rule-matched leaves keep their 'model'/'expert'
 spec, everything else falls to the FSDP shape policy); the `rules`
@@ -27,19 +45,50 @@ from __future__ import annotations
 
 import dataclasses
 import math
+from typing import Sequence
 
 import jax
-from jax.sharding import PartitionSpec as P
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import NamedSharding, PartitionSpec as P
 
+from distributed_model_parallel_tpu.models.layers import Context
+from distributed_model_parallel_tpu.ops.grad_reduction import (
+    bucketed_pmean,
+    data_replica_index,
+)
+from distributed_model_parallel_tpu.parallel.data_parallel import (
+    TrainState,
+    _apply_input_transform,
+    _cast_input,
+    _metrics,
+    aux_loss,
+)
 from distributed_model_parallel_tpu.parallel.tensor_parallel import (
     TensorParallelEngine,
 )
+from distributed_model_parallel_tpu.runtime.compat import shard_map
+from distributed_model_parallel_tpu.runtime.mesh import (
+    data_axis_names,
+    data_axis_size,
+    data_hierarchy_axes,
+)
+from distributed_model_parallel_tpu.training.metrics import cross_entropy
 
 
-def fsdp_specs(params_aval, n_shards: int, *, min_shard_elems: int = 1024):
-    """Shape-driven PartitionSpec pytree: each leaf sharded over 'data'
-    along its largest dimension divisible by `n_shards`; leaves smaller
-    than `min_shard_elems` (or with no divisible dim) stay replicated."""
+def fsdp_specs(
+    params_aval,
+    n_shards: int,
+    *,
+    min_shard_elems: int = 1024,
+    axes: Sequence[str] | str = "data",
+):
+    """Shape-driven PartitionSpec pytree: each leaf sharded over the
+    data axis/axes along its largest dimension divisible by `n_shards`;
+    leaves smaller than `min_shard_elems` (or with no divisible dim)
+    stay replicated. `axes` is the mesh spelling of the data-parallel
+    world — 'data', or ('dcn', 'ici') on a hybrid mesh."""
+    entry = tuple(axes) if not isinstance(axes, str) else axes
 
     def spec_of(leaf):
         shape = getattr(leaf, "shape", ())
@@ -51,22 +100,38 @@ def fsdp_specs(params_aval, n_shards: int, *, min_shard_elems: int = 1024):
         for d in dims:
             if shape[d] % n_shards == 0:
                 parts = [None] * len(shape)
-                parts[d] = "data"
+                parts[d] = entry
                 return P(*parts)
         return P()
 
     return jax.tree_util.tree_map(spec_of, params_aval)
 
 
+def _sharded_dim(spec: P):
+    """(dim, axes) of the single sharded dimension in an fsdp spec, or
+    (None, None) for replicated leaves."""
+    for d, part in enumerate(spec):
+        if part is not None:
+            return d, part
+    return None, None
+
+
 @dataclasses.dataclass
 class FSDPEngine(TensorParallelEngine):
     """GSPMD fully-sharded data parallelism: batch AND parameters (and
-    optimizer moments, via `state_shardings`) sharded over 'data'. Same
-    API as every other engine."""
+    optimizer moments, via `state_shardings`) sharded over the data
+    axes. Same API as every other engine. `grad_reduction="bucketed"`
+    selects the explicit bucketed-reduce-scatter step (module
+    docstring)."""
 
     rules: tuple = ()  # shape-driven engine: rules are rejected, below
     # Leaves below this many elements stay replicated (BN scales etc.).
     min_shard_elems: int = 1024
+    # "monolithic": declarative jit step, partitioner-inserted
+    # gather/scatter (default). "bucketed": explicit shard_map step with
+    # Reducer-style hierarchical flat-bucket gradient reduction.
+    grad_reduction: str = "monolithic"
+    bucket_mb: float = 25.0
 
     def __post_init__(self):
         if self.rules:
@@ -76,12 +141,169 @@ class FSDPEngine(TensorParallelEngine):
                 "and override param_specs to compose FSDP with "
                 "'model'/'expert' rule sharding."
             )
-        super().__post_init__()
+        if self.grad_reduction not in ("monolithic", "bucketed"):
+            raise ValueError(
+                "grad_reduction must be 'monolithic' or 'bucketed', "
+                f"got {self.grad_reduction!r}"
+            )
+        if self.grad_reduction == "bucketed":
+            if self.collective_matmul:
+                # The explicit step below never threads a matmul policy
+                # through Context — silently dropping the flag would
+                # train without the requested rings (the monolithic
+                # path at least fails on its missing 'model' axis).
+                raise ValueError(
+                    "collective_matmul=True is not supported by the "
+                    "bucketed FSDP step (no matmul policy is threaded "
+                    "through the explicit shard_map program)"
+                )
+            self._build_bucketed()
+        else:
+            super().__post_init__()
 
     def param_specs(self, p_aval):
         return fsdp_specs(
-            p_aval, self.mesh.shape["data"],
+            p_aval, data_axis_size(self.mesh),
             min_shard_elems=self.min_shard_elems,
+            axes=data_axis_names(self.mesh),
+        )
+
+    # ------------------------------------- explicit bucketed-RS step
+
+    def _build_bucketed(self):
+        """The shard_map twin of the declarative step: same state
+        layout (`_state_sh`), explicit collectives — per-leaf weight
+        all-gather on entry, bucketed hierarchical gradient reduction,
+        local 1/N slice, sharded optimizer update."""
+        mesh = self.mesh
+        d_axes, ici_axis, dcn_axis = data_hierarchy_axes(mesh)
+        n_data = data_axis_size(mesh)
+        self._repl = NamedSharding(mesh, P())
+        self._batch = NamedSharding(mesh, P(d_axes))
+        cdt = self.compute_dtype
+        tf = self.input_transform
+        model = self.model
+        bucket_mb = self.bucket_mb
+
+        key_aval = jax.ShapeDtypeStruct((2,), jnp.uint32)
+        p_aval, s_aval = jax.eval_shape(model.init, key_aval)
+        pspecs = self.param_specs(p_aval)
+        is_spec = lambda x: isinstance(x, P)  # noqa: E731
+        param_sh = jax.tree_util.tree_map(
+            lambda spec: NamedSharding(mesh, spec), pspecs,
+            is_leaf=is_spec,
+        )
+        self._state_sh = TrainState(
+            param_sh,
+            jax.tree_util.tree_map(lambda _: self._repl, s_aval),
+            self.optimizer.state_shardings(param_sh, self._repl),
+            self._repl,
+        )
+        # The same layout as P specs, for shard_map in/out_specs.
+        state_specs = TrainState(
+            pspecs,
+            jax.tree_util.tree_map(lambda _: P(), s_aval),
+            self.optimizer.state_shardings(pspecs, P()),
+            P(),
+        )
+
+        def gather_params(params):
+            """Per-leaf weight all-gather: the ZeRO-3 'materialize right
+            before use' collective, explicit."""
+
+            def gather(leaf, spec):
+                d, axes = _sharded_dim(spec)
+                if d is None:
+                    return leaf
+                return lax.all_gather(leaf, axes, axis=d, tiled=True)
+
+            return jax.tree_util.tree_map(gather, params, pspecs)
+
+        def shard_grads(grads):
+            """Slice this device's 1/N of each fully-reduced leaf —
+            local, no collective (the bucket rings already placed the
+            reduced bytes everywhere)."""
+            idx = data_replica_index(d_axes)
+
+            def slice_leaf(leaf, spec):
+                d, _ = _sharded_dim(spec)
+                if d is None:
+                    return leaf
+                block = leaf.shape[d] // n_data
+                return lax.dynamic_slice_in_dim(
+                    leaf, idx * block, block, axis=d
+                )
+
+            return jax.tree_util.tree_map(slice_leaf, grads, pspecs)
+
+        def shard_step(ts: TrainState, images, labels, lr):
+            rng = jax.random.fold_in(
+                jax.random.fold_in(jax.random.PRNGKey(0), ts.step),
+                data_replica_index(d_axes),
+            )
+            images_c = _cast_input(
+                _apply_input_transform(tf, images, ts.step, True), cdt
+            )
+            full_params = gather_params(ts.params)
+
+            def loss_fn(params, model_state):
+                # bn_axis: global batch statistics, matching the
+                # declarative engine (plain jit = SyncBN semantics).
+                logits, new_state = model.apply(
+                    params, model_state, images_c,
+                    Context(train=True, bn_axis=d_axes, rng=rng,
+                            dtype=cdt),
+                )
+                ce = cross_entropy(logits, labels)
+                return ce + aux_loss(new_state), (new_state, logits, ce)
+
+            (_, (new_state, logits, ce)), grads = jax.value_and_grad(
+                loss_fn, has_aux=True
+            )(full_params, ts.model_state)
+            grads = bucketed_pmean(
+                grads, ici_axis, dcn_axis, bucket_mb=bucket_mb
+            )
+            params, opt_state = self.optimizer.update(
+                ts.params, ts.opt_state, shard_grads(grads), lr
+            )
+            new_ts = TrainState(params, new_state, opt_state, ts.step + 1)
+            m = _metrics(ce, logits, labels)
+            m = jax.tree_util.tree_map(
+                lambda v: lax.psum(v, d_axes), m
+            )
+            return new_ts, m
+
+        def shard_eval(ts: TrainState, images, labels):
+            images_c = _cast_input(
+                _apply_input_transform(tf, images, ts.step, False), cdt
+            )
+            logits, _ = model.apply(
+                gather_params(ts.params), ts.model_state, images_c,
+                Context(train=False, dtype=cdt),
+            )
+            loss = cross_entropy(logits, labels)
+            m = _metrics(loss, logits, labels)
+            return jax.tree_util.tree_map(
+                lambda v: lax.psum(v, d_axes), m
+            )
+
+        donate = (0,) if self.donate else ()
+        self.train_step = jax.jit(
+            shard_map(
+                shard_step, mesh=mesh,
+                in_specs=(state_specs, P(d_axes), P(d_axes), P()),
+                out_specs=(state_specs, P()),
+                check_vma=False,
+            ),
+            donate_argnums=donate,
+        )
+        self.eval_step = jax.jit(
+            shard_map(
+                shard_eval, mesh=mesh,
+                in_specs=(state_specs, P(d_axes), P(d_axes)),
+                out_specs=P(),
+                check_vma=False,
+            )
         )
 
 

@@ -52,10 +52,17 @@ from distributed_model_parallel_tpu.ops.ring_attention import (
     ring_flash_attention,
     ulysses_attention,
 )
+from distributed_model_parallel_tpu.ops.grad_reduction import (
+    bucketed_psum,
+    data_replica_index,
+)
 from distributed_model_parallel_tpu.parallel.data_parallel import (
     TrainState,
     _metrics,
     _place_batch,
+)
+from distributed_model_parallel_tpu.runtime.mesh import (
+    data_hierarchy_axes,
 )
 from distributed_model_parallel_tpu.training.metrics import cross_entropy
 from distributed_model_parallel_tpu.training.optim import SGD
@@ -327,6 +334,15 @@ class CausalLMSequenceParallelEngine:
     # FFN pair as chunked ppermute rings over 'seq' (default off) — see
     # SequenceParallelEngine.collective_matmul.
     collective_matmul: bool = False
+    # Gradient reduction over the DATA axes (the 'seq' psum is separate:
+    # per-shard grads are complementary pieces, summed first either
+    # way). "monolithic": one fused psum over ('seq', data axes).
+    # "bucketed": Reducer-style flat buckets over the data fabric(s) —
+    # ring reduce-scatter over 'ici', cross-slice all-reduce over 'dcn',
+    # ring all-gather (`ops/grad_reduction.py`); hierarchy-aware on a
+    # `MeshSpec(dcn=K)` mesh.
+    grad_reduction: str = "monolithic"
+    bucket_mb: float = 25.0
 
     def __post_init__(self):
         from distributed_model_parallel_tpu.models.gpt import (
@@ -345,6 +361,14 @@ class CausalLMSequenceParallelEngine:
                 f"attention must be one of {sorted(ATTENTION)}, "
                 f"got {self.attention!r}"
             )
+        if self.grad_reduction not in ("monolithic", "bucketed"):
+            raise ValueError(
+                "grad_reduction must be 'monolithic' or 'bucketed', "
+                f"got {self.grad_reduction!r}"
+            )
+        d_axes, ici_axis, dcn_axis = data_hierarchy_axes(mesh)
+        bucketed = self.grad_reduction == "bucketed"
+        bucket_mb = self.bucket_mb
         cfg = self.cfg
         self._lm_targets = partial(
             lm_targets, pad_token_id=cfg.pad_token_id
@@ -357,7 +381,7 @@ class CausalLMSequenceParallelEngine:
         )
         mm = self._matmul
         self._repl = NamedSharding(mesh, P())
-        self._batch = NamedSharding(mesh, P(("data",), ("seq",)))
+        self._batch = NamedSharding(mesh, P(d_axes, ("seq",)))
         # Dense-parameter twin used ONLY for init (identical pytree).
         self._full = gpt_lm(cfg)
         block_list = decoder_blocks(cfg, attn_fn)
@@ -399,11 +423,13 @@ class CausalLMSequenceParallelEngine:
                 cross_entropy(flat_logits, flat_t), flat_logits, flat_t
             )
 
+        reduce_axes = ("seq",) + d_axes
+
         def shard_step(ts: TrainState, ids, targets, lr):
             rng = jax.random.fold_in(
                 jax.random.fold_in(
                     jax.random.fold_in(jax.random.PRNGKey(0), ts.step),
-                    lax.axis_index("data"),
+                    data_replica_index(d_axes),
                 ),
                 lax.axis_index("seq"),
             )
@@ -419,12 +445,26 @@ class CausalLMSequenceParallelEngine:
             (_, m), grads = jax.value_and_grad(loss_fn, has_aux=True)(
                 ts.params
             )
-            n_global = lax.psum(m["count"], ("seq", "data"))
-            grads = jax.tree_util.tree_map(
-                lambda g: lax.psum(g, ("seq", "data"))
-                / jnp.maximum(n_global, 1.0),
-                grads,
-            )
+            n_global = lax.psum(m["count"], reduce_axes)
+            if bucketed:
+                # 'seq' first (complementary per-shard pieces — one
+                # fused psum over the TP-style axis), then the
+                # Reducer-style buckets over the data fabric(s).
+                grads = bucketed_psum(
+                    jax.tree_util.tree_map(
+                        lambda g: lax.psum(g, "seq"), grads
+                    ),
+                    ici_axis, dcn_axis, bucket_mb=bucket_mb,
+                )
+                grads = jax.tree_util.tree_map(
+                    lambda g: g / jnp.maximum(n_global, 1.0), grads
+                )
+            else:
+                grads = jax.tree_util.tree_map(
+                    lambda g: lax.psum(g, reduce_axes)
+                    / jnp.maximum(n_global, 1.0),
+                    grads,
+                )
             params, opt_state = self.optimizer.update(
                 ts.params, ts.opt_state, grads, lr
             )
@@ -432,7 +472,7 @@ class CausalLMSequenceParallelEngine:
                 params, ts.model_state, opt_state, ts.step + 1
             )
             return new_ts, {
-                k: lax.psum(v, ("seq", "data")) for k, v in m.items()
+                k: lax.psum(v, reduce_axes) for k, v in m.items()
             }
 
         def shard_eval(ts: TrainState, ids, targets):
@@ -441,14 +481,14 @@ class CausalLMSequenceParallelEngine:
                 L.Context(train=False, dtype=cdt, matmul=mm),
             )
             m = local_sums(logits, targets)
-            return {k: lax.psum(v, ("seq", "data")) for k, v in m.items()}
+            return {k: lax.psum(v, reduce_axes) for k, v in m.items()}
 
         donate = (0,) if self.donate else ()
         self.train_step = jax.jit(
             shard_map(
                 shard_step, mesh=mesh,
                 in_specs=(
-                    P(), P(("data",), ("seq",)), P(("data",), ("seq",)),
+                    P(), P(d_axes, ("seq",)), P(d_axes, ("seq",)),
                     P(),
                 ),
                 out_specs=(P(), P()),
@@ -460,7 +500,7 @@ class CausalLMSequenceParallelEngine:
             shard_map(
                 shard_eval, mesh=mesh,
                 in_specs=(
-                    P(), P(("data",), ("seq",)), P(("data",), ("seq",)),
+                    P(), P(d_axes, ("seq",)), P(d_axes, ("seq",)),
                 ),
                 out_specs=P(),
                 check_vma=False,

@@ -46,6 +46,7 @@ from distributed_model_parallel_tpu.parallel.data_parallel import (
     _place_batch,
     aux_loss,
 )
+from distributed_model_parallel_tpu.runtime.mesh import data_axis_names
 from distributed_model_parallel_tpu.training.checkpoint import _path_str
 from distributed_model_parallel_tpu.training.metrics import cross_entropy
 from distributed_model_parallel_tpu.training.optim import SGD
@@ -122,7 +123,7 @@ class TensorParallelEngine:
                 f"sharding rules (mesh axes: {mesh.axis_names})"
             )
         self._repl = NamedSharding(mesh, P())
-        self._batch = NamedSharding(mesh, P(("data",)))
+        self._batch = NamedSharding(mesh, P(data_axis_names(mesh)))
         self._matmul = None
         if self.collective_matmul:
             if "model" not in mesh.axis_names:
@@ -138,7 +139,8 @@ class TensorParallelEngine:
             self._matmul = CollectiveMatmul(
                 mesh=mesh, axis="model",
                 batch_axes=tuple(
-                    a for a in ("data",) if a in mesh.axis_names
+                    a for a in data_axis_names(mesh)
+                    if a in mesh.axis_names
                 ),
             )
         mm = self._matmul

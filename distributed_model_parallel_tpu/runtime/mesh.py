@@ -20,30 +20,49 @@ and every engine addresses devices by axis name:
 A `MeshSpec` replaces `--world-size N`: any axis left at -1 absorbs the
 remaining devices, so `MeshSpec(stage=4)` on 8 chips gives a
 (2, 4, 1, 1, 1) mesh the way `--world-size 4` gave a 4-rank pipeline.
+
+`MeshSpec(dcn=K)` factors the data axis over the two TPU fabrics: the
+mesh then carries ('dcn', 'ici', ...) in place of 'data', with 'dcn'
+the cross-slice (data-center network) factor and 'ici' the intra-slice
+ring. Collectives can address the fabrics separately — the bucketed
+gradient reducer (`ops/grad_reduction.py`) reduce-scatters over 'ici'
+and all-reduces only the 1/N shard over 'dcn', the hierarchy PyTorch's
+DDP gets from NCCL topology detection. Engines that shard a batch use
+`data_axis_names(mesh)` instead of the literal 'data' so both mesh
+families work. On a multi-process runtime the hybrid mesh is built with
+`mesh_utils.create_hybrid_device_mesh` (slices = process granules);
+single-process it is a virtual split of one host's devices.
 """
 
 from __future__ import annotations
 
 import dataclasses
 import math
-from typing import Optional, Sequence
+from typing import Optional, Sequence, Tuple
 
 import jax
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 AXES = ("data", "stage", "model", "seq", "expert")
+# The factored spelling of the data axis on a hybrid (dcn>1) mesh:
+# 'dcn' is slice-major (matches process granularity), 'ici' minor.
+DATA_AXES_HYBRID = ("dcn", "ici")
 
 
 @dataclasses.dataclass(frozen=True)
 class MeshSpec:
-    """Logical mesh shape. -1 on exactly one axis means 'all remaining devices'."""
+    """Logical mesh shape. -1 on exactly one axis means 'all remaining
+    devices'. `dcn` is the cross-slice factor of the data axis (1 =
+    single fabric, the 'data' axis stays whole); it must divide the
+    resolved data size."""
 
     data: int = -1
     stage: int = 1
     model: int = 1
     seq: int = 1
     expert: int = 1
+    dcn: int = 1
 
     def resolve(self, n_devices: int) -> tuple[int, ...]:
         dims = [self.data, self.stage, self.model, self.seq, self.expert]
@@ -61,6 +80,12 @@ class MeshSpec:
             raise ValueError(
                 f"mesh {dims} needs {fixed} devices but {n_devices} present"
             )
+        if self.dcn < 1:
+            raise ValueError(f"dcn must be >= 1, got {self.dcn}")
+        if dims[0] % self.dcn:
+            raise ValueError(
+                f"dcn={self.dcn} must divide the data axis ({dims[0]})"
+            )
         return tuple(dims)
 
 
@@ -74,12 +99,67 @@ def make_mesh(
 
     Replaces `dist.init_process_group(...)` + rank arithmetic: after this,
     "which device does what" is a sharding annotation, not a script branch.
+
+    With `spec.dcn > 1` the data axis splits into ('dcn', 'ici'): on a
+    multi-process runtime the device order comes from
+    `mesh_utils.create_hybrid_device_mesh` (each process granule is one
+    slice, so 'ici' neighbors really are ICI neighbors); single-process
+    it is a virtual split — the two-fabric PROGRAM structure on one
+    host's devices.
     """
     spec = spec or MeshSpec()
     devices = list(devices if devices is not None else jax.devices())
     shape = spec.resolve(len(devices))
-    dev_array = np.asarray(devices).reshape(shape)
-    return Mesh(dev_array, axis_names=tuple(axis_names))
+    if spec.dcn == 1:
+        dev_array = np.asarray(devices).reshape(shape)
+        return Mesh(dev_array, axis_names=tuple(axis_names))
+    dcn = spec.dcn
+    ici = shape[0] // dcn
+    hybrid_shape = (dcn, ici) + shape[1:]
+    if jax.process_count() > 1:
+        from jax.experimental import mesh_utils
+
+        dev_array = mesh_utils.create_hybrid_device_mesh(
+            (ici,) + shape[1:],
+            (dcn,) + (1,) * (len(shape) - 1),
+            devices=devices,
+        ).reshape(hybrid_shape)
+    else:
+        dev_array = np.asarray(devices).reshape(hybrid_shape)
+    names = DATA_AXES_HYBRID + tuple(axis_names[1:])
+    return Mesh(dev_array, axis_names=names)
+
+
+def data_axis_names(mesh: Mesh) -> Tuple[str, ...]:
+    """The axis-name tuple the data-parallel world lives on: ('data',)
+    on a plain mesh, ('dcn', 'ici') on a hybrid one. Engines shard
+    batches with `P(data_axis_names(mesh))` and reduce gradients over
+    the same tuple, so one code path serves both mesh families."""
+    return (
+        DATA_AXES_HYBRID
+        if DATA_AXES_HYBRID[0] in mesh.axis_names
+        else ("data",)
+    )
+
+
+def data_axis_size(mesh: Mesh) -> int:
+    """Total data-parallel ways (the product over `data_axis_names`)."""
+    return int(
+        math.prod(mesh.shape[a] for a in data_axis_names(mesh))
+    )
+
+
+def data_hierarchy_axes(mesh: Mesh):
+    """(data_axes, ici_axis, dcn_axis) for gradient-reduction wiring:
+    the full tuple for batch shards / fused collectives, the intra-
+    slice axis the bucket rings run over, and the cross-slice axis for
+    the 1/S-shard all-reduce (None on a single-fabric mesh). The one
+    place the hybrid-axis convention is decoded — engines must not
+    re-derive it."""
+    d_axes = data_axis_names(mesh)
+    ici_axis = d_axes[-1]
+    dcn_axis = d_axes[0] if len(d_axes) > 1 else None
+    return d_axes, ici_axis, dcn_axis
 
 
 def local_mesh(**axes: int) -> Mesh:
@@ -95,7 +175,7 @@ def sharding(mesh: Mesh, *spec) -> NamedSharding:
 def batch_sharding(mesh: Mesh) -> NamedSharding:
     """Input-batch sharding: the TPU equivalent of DataParallel's `scatter`
     (reference `Readme.md:19-29`) — no device-0 hop, each host feeds its shard."""
-    return NamedSharding(mesh, P(("data",)))
+    return NamedSharding(mesh, P(data_axis_names(mesh)))
 
 
 def replicated(mesh: Mesh) -> NamedSharding:

@@ -43,9 +43,14 @@ def compile_multi_step(engine: Any, k: int) -> Callable:
     `engine.shard_batch` (already device-placed). The returned metrics
     dict holds the SUM over the k steps of the engine's per-step metric
     sums — the same value accumulating k per-step results would give.
+
+    k=1 is a passthrough: a one-step scan whose state/metrics match a
+    single `engine.train_step` call (pinned in tests/test_multistep.py)
+    — callers can treat every dispatch uniformly instead of special-
+    casing the last short group of an epoch.
     """
-    if k < 2:
-        raise ValueError(f"steps_per_dispatch must be >= 2, got {k}")
+    if k < 1:
+        raise ValueError(f"steps_per_dispatch must be >= 1, got {k}")
 
     def k_steps(state, batches: Tuple, lr):
         # Leaf-wise stack of the k batch tuples -> scan operands with a
@@ -70,9 +75,10 @@ def compile_multi_step(engine: Any, k: int) -> Callable:
 def compile_multi_eval(engine: Any, k: int) -> Callable:
     """Eval twin of `compile_multi_step`: `fn(state, batches) ->
     summed_metrics` evaluating k batches in one compiled program
-    (state is read-only — no carry, a plain scan over the stack)."""
-    if k < 2:
-        raise ValueError(f"steps_per_dispatch must be >= 2, got {k}")
+    (state is read-only — no carry, a plain scan over the stack).
+    k=1 is a passthrough, like `compile_multi_step`."""
+    if k < 1:
+        raise ValueError(f"steps_per_dispatch must be >= 1, got {k}")
 
     def k_evals(state, batches: Tuple):
         stacked = jax.tree_util.tree_map(

@@ -70,6 +70,16 @@ BW_ICI_EFFECTIVE = 100e9  # bytes/s usable per ring direction
 # unfused all-reduces — which is exactly what ResNet-50's own 64-way
 # compile shows on this backend (step 2).
 ALPHA_HOP_S = 1e-6
+# Two-level (dcn × ici) hierarchy for the bucketed reducer
+# (`ops/grad_reduction.py`): a 64-chip job as 2 slices × 32 chips.
+# Cross-slice (data-center network) effective bandwidth is an order of
+# magnitude below ICI — public multislice numbers put per-chip DCN
+# throughput in the tens of GB/s aggregate per slice; conservative:
+DCN_SLICES = 2
+BW_DCN_EFFECTIVE = 25e9  # bytes/s usable across the slice boundary
+# Cross-slice hop latency: DCN is a routed network, not a torus link.
+ALPHA_DCN_HOP_S = 10e-6
+BUCKET_MB = 25.0  # the reducer's default bucket_cap_mb
 
 
 def optimized_all_reduce_bytes(text):
@@ -211,6 +221,45 @@ def main():
           f"{eff_overlap:.3f} (full overlap); "
           f"{eff_bucketed:.3f} (no overlap, bucketed)")
 
+    # ---- 3b. two-level alpha-beta: the hierarchical bucketed reducer -
+    # 64 chips as DCN_SLICES slices × ici chips. A FLAT 64-ring would
+    # push the full gradient through the slice boundary at DCN
+    # bandwidth (its slowest link gates the ring); the hierarchical
+    # reducer (`ops/grad_reduction.py` — reduce-scatter over 'ici',
+    # all-reduce of the 1/ici shard over 'dcn', all-gather back) keeps
+    # the DCN bytes at 1/ici of the payload. Alpha counts per-bucket
+    # hops (the Reducer's ~25 MB buckets), each fabric at its own hop
+    # cost.
+    ici = N // DCN_SLICES
+    n_buckets = max(1, -(-opt_ar_bytes // int(BUCKET_MB * 2**20)))
+    beta_flat_dcn_s = 2 * (N - 1) / N * opt_ar_bytes / BW_DCN_EFFECTIVE
+    comm_flat_dcn_s = beta_flat_dcn_s + alpha_bucketed_s
+    beta_two_level_s = (
+        2 * (ici - 1) / ici * opt_ar_bytes / BW_ICI_EFFECTIVE
+        + 2 * (DCN_SLICES - 1) / DCN_SLICES
+        * (opt_ar_bytes / ici) / BW_DCN_EFFECTIVE
+    )
+    alpha_two_level_s = n_buckets * (
+        2 * (ici - 1) * ALPHA_HOP_S
+        + 2 * (DCN_SLICES - 1) * ALPHA_DCN_HOP_S
+    )
+    comm_two_level_s = beta_two_level_s + alpha_two_level_s
+    eff_flat_dcn = MEASURED_STEP_S / (MEASURED_STEP_S + comm_flat_dcn_s)
+    eff_two_level = MEASURED_STEP_S / (
+        MEASURED_STEP_S + comm_two_level_s
+    )
+    eff_two_level_overlap = MEASURED_STEP_S / max(
+        MEASURED_STEP_S, comm_two_level_s
+    )
+    print(f"two-level ({DCN_SLICES}x{ici} dcn*ici, {n_buckets} buckets "
+          f"of {BUCKET_MB:.0f} MB): {beta_two_level_s*1e3:.2f} ms "
+          f"bandwidth + {alpha_two_level_s*1e3:.2f} ms latency "
+          f"(flat ring gated by DCN: {beta_flat_dcn_s*1e3:.2f} ms)")
+    print(f"predicted weak-scaling efficiency @64 across 2 slices: "
+          f"{eff_flat_dcn:.3f} (flat ring over DCN) -> "
+          f"{eff_two_level:.3f} (hierarchical bucketed, no overlap) .. "
+          f"{eff_two_level_overlap:.3f} (full overlap)")
+
     out = {
         "n_devices": N,
         "per_chip_batch": PER_CHIP_BATCH,
@@ -236,6 +285,22 @@ def main():
             eff_overlap, 4),
         "predicted_weak_scaling_eff_64_bucketed_no_overlap": round(
             eff_bucketed, 4),
+        # two-level (dcn × ici) hierarchical bucketed reducer row
+        "dcn_slices": DCN_SLICES,
+        "dcn_bw_effective_bytes_per_s": BW_DCN_EFFECTIVE,
+        "alpha_dcn_hop_s": ALPHA_DCN_HOP_S,
+        "bucket_mb": BUCKET_MB,
+        "n_buckets": int(n_buckets),
+        "ring_allreduce_flat_over_dcn_s": round(comm_flat_dcn_s, 6),
+        "two_level_beta_s": round(beta_two_level_s, 6),
+        "two_level_alpha_s": round(alpha_two_level_s, 6),
+        "two_level_s": round(comm_two_level_s, 6),
+        "predicted_weak_scaling_eff_64_2slice_flat_ring": round(
+            eff_flat_dcn, 4),
+        "predicted_weak_scaling_eff_64_2slice_hierarchical": round(
+            eff_two_level, 4),
+        "predicted_weak_scaling_eff_64_2slice_hierarchical_overlap":
+            round(eff_two_level_overlap, 4),
     }
     path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                         "scaling64.json")

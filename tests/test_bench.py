@@ -39,7 +39,7 @@ def test_preflight_probe_gives_up_fast_on_hanging_dial(monkeypatch):
     budgets — the whole phase fits the < 30 s fail-fast contract."""
     calls = []
 
-    def fake_spawn(args, timeout_s, env=None):
+    def fake_spawn(args, timeout_s, env=None, **kw):
         calls.append((list(args), timeout_s))
         return None, "", ""  # killed after timeout, nothing written
 
@@ -60,7 +60,7 @@ def test_preflight_probe_gives_up_fast_on_hanging_dial(monkeypatch):
 
 
 def test_preflight_probe_accepts_accelerator_answer(monkeypatch):
-    def fake_spawn(args, timeout_s, env=None):
+    def fake_spawn(args, timeout_s, env=None, **kw):
         line = json.dumps({
             "probe": "ok", "platform": "tpu", "device_kind": "TPU v5e",
             "n_chips": 1, "dial_s": 2.5,
@@ -76,7 +76,7 @@ def test_preflight_probe_accepts_accelerator_answer(monkeypatch):
 def test_preflight_probe_treats_cpu_degrade_as_failure(monkeypatch):
     """A probe that 'succeeds' on the cpu platform means the tunnel
     degraded — the accelerator child must not get the budget."""
-    def fake_spawn(args, timeout_s, env=None):
+    def fake_spawn(args, timeout_s, env=None, **kw):
         line = json.dumps({
             "probe": "ok", "platform": "cpu", "device_kind": "cpu",
             "n_chips": 8, "dial_s": 0.1,
@@ -99,7 +99,7 @@ def test_main_skips_accelerator_child_after_probe_failure(
     diagnosis."""
     calls = []
 
-    def fake_spawn(args, timeout_s, env=None):
+    def fake_spawn(args, timeout_s, env=None, **kw):
         calls.append(list(args))
         if "--child-probe" in args:
             return None, "", ""  # wedged dial: killed, no output
@@ -137,7 +137,7 @@ def test_sweep_child_failure_rescues_partial_legs(monkeypatch, capsys):
         {"chips": 2, "img_per_sec_per_chip": 97.0},
     ]
 
-    def fake_spawn(args, timeout_s, env=None):
+    def fake_spawn(args, timeout_s, env=None, **kw):
         out = "".join(
             json.dumps({"leg": leg, "partial": True}) + "\n"
             for leg in legs
@@ -152,6 +152,156 @@ def test_sweep_child_failure_rescues_partial_legs(monkeypatch, capsys):
     assert out[0]["scaling"] == legs
     assert out[0]["metric"] == bench.METRIC
     assert "rc=None" in out[0]["error"]
+
+
+# ---------------------------------------------- dial watchdog (r5 fix)
+# BENCH_r05: the pre-probe passed, then the measurement child hung its
+# whole 390 s budget inside jax.devices() (its inner SIGALRM never
+# fires in non-GIL-releasing plugin code). The parent now enforces the
+# probe's verdict itself: no "backend up" line on the child's stderr
+# within DIAL_WATCHDOG_S => process-group kill and straight to the CPU
+# diagnostic, keeping a dead relay under 60 s.
+
+
+def _sleeper(code: str):
+    import subprocess
+    import sys
+
+    return subprocess.Popen(
+        [sys.executable, "-u", "-c", code],
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+        start_new_session=True,
+    )
+
+
+def test_watch_child_dial_watchdog_kills_markerless_child():
+    """A child that never prints the dial marker dies at the DIAL bound
+    (seconds), not the overall timeout (minutes)."""
+    import time
+
+    child = _sleeper("import time; time.sleep(60)")
+    bench._current_child = child
+    t0 = time.monotonic()
+    rc, out, err = bench._watch_child(
+        child, timeout_s=120, dial_timeout_s=1.0
+    )
+    elapsed = time.monotonic() - t0
+    assert rc is None
+    assert "dial watchdog" in err
+    assert elapsed < 15  # killed at ~1 s + drain, nowhere near 120
+    assert child.poll() is not None  # really dead, nothing orphaned
+
+
+def test_watch_child_marker_disarms_dial_watchdog():
+    """Once 'backend up' streams on stderr the dial watchdog stands
+    down: the child runs to completion and its output is returned."""
+    child = _sleeper(
+        "import sys, time; print('backend up in 0.1s', file=sys.stderr,"
+        " flush=True); time.sleep(2); print('{\"ok\": 1}')"
+    )
+    bench._current_child = child
+    rc, out, err = bench._watch_child(
+        child, timeout_s=60, dial_timeout_s=1.0
+    )
+    assert rc == 0
+    assert "backend up" in err
+    assert '{"ok": 1}' in out
+
+
+def test_main_dial_watchdog_fires_fast_after_ok_probe(
+    monkeypatch, capsys
+):
+    """The r5 scenario end-to-end (stubbed): probe ok, measurement
+    child's dial wedges. main() must (a) hand the child a dial bound
+    <= DIAL_WATCHDOG_S, (b) NOT retry the killed child, (c) fall to the
+    CPU diagnostic with BOTH diagnoses — the watchdog kill and the
+    probe's earlier answer — in the JSON."""
+    accel_spawns = []
+
+    def fake_spawn(args, timeout_s, env=None, dial_timeout_s=None):
+        if "--child-probe" in args:
+            return 0, json.dumps({
+                "probe": "ok", "platform": "tpu",
+                "device_kind": "TPU v5e", "n_chips": 4, "dial_s": 2.1,
+            }) + "\n", ""
+        if "--child-cpu" in args:
+            return 0, json.dumps({
+                "metric": bench.METRIC, "value": 42.0,
+                "unit": "images/sec", "vs_baseline": 0.03,
+                "platform": "cpu", "model": "tinycnn", "batch": 256,
+            }) + "\n", ""
+        # the patient accelerator child: its dial wedges
+        accel_spawns.append(dial_timeout_s)
+        assert dial_timeout_s is not None
+        assert dial_timeout_s <= bench.DIAL_WATCHDOG_S
+        assert env is not None and "BENCH_DIAL_TIMEOUT_S" in env
+        return None, "", (
+            f"child killed by {dial_timeout_s:.0f}s dial watchdog — "
+            "'backend up' never appeared on stderr; backend dial wedged"
+        )
+
+    monkeypatch.setattr(bench, "_spawn", fake_spawn)
+    bench.main()
+    out = _parse_lines(capsys.readouterr().out)
+    assert out, "main() must always print a JSON line"
+    final = out[-1]
+    assert final["backend"] == "unreachable"
+    assert "dial watchdog" in final["error"]
+    assert "pre-probe had answered" in final["error"]  # probe diagnosis
+    assert "TPU v5e" in final["error"]
+    assert final["metric"] == bench.METRIC
+    # killed by the watchdog => patience consumed => exactly one spawn
+    assert len(accel_spawns) == 1
+
+
+def test_reducer_microbench_flag_is_wired():
+    """`--reducer-microbench` and its internal `--child-reducer` parse
+    (the parent spawns exactly that argv); mutual exclusion with the
+    other sweeps holds."""
+    import os
+    import subprocess
+    import sys
+
+    env = {**os.environ, "JAX_PLATFORMS": "cpu"}
+    res = subprocess.run(
+        [sys.executable, os.path.abspath(bench.__file__), "--help"],
+        capture_output=True, text=True, timeout=60, env=env,
+    )
+    assert res.returncode == 0
+    assert "--reducer-microbench" in res.stdout
+    assert "--child-reducer" in res.stdout
+    res = subprocess.run(
+        [sys.executable, os.path.abspath(bench.__file__),
+         "--scaling", "--reducer-microbench"],
+        capture_output=True, text=True, timeout=60, env=env,
+    )
+    assert res.returncode != 0
+    assert "mutually exclusive" in res.stderr
+
+
+def test_reducer_sweep_failure_rescues_partial_legs(
+    monkeypatch, capsys
+):
+    """The reducer sweep rides the same per-leg rescue convention as
+    the scaling/cm sweeps."""
+    legs = [{"axis_size": 2, "naive_ms": 1.0, "bucketed_ms": 0.9,
+             "hierarchical_ms": 0.8}]
+
+    def fake_spawn(args, timeout_s, env=None, **kw):
+        out = "".join(
+            json.dumps({"leg": leg, "partial": True}) + "\n"
+            for leg in legs
+        )
+        return None, out, "child killed after timeout"
+
+    monkeypatch.setattr(bench, "_spawn", fake_spawn)
+    bench._run_sweep_child(
+        ["--child-reducer"], None, "reducer_microbench"
+    )
+    out = _parse_lines(capsys.readouterr().out)
+    assert len(out) == 1
+    assert out[0]["reducer_microbench"] == legs
+    assert out[0]["backend"] == "unreachable"
 
 
 def test_probe_flag_is_wired():

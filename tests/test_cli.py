@@ -50,10 +50,18 @@ def test_data_parallel_cli_fsdp(tmp_path, monkeypatch):
     assert len(result["history"]) == 1
 
 
+@pytest.mark.slow
 def test_data_parallel_cli_tp_collective_matmul(tmp_path, monkeypatch):
     """--engine tp --collective-matmul drives the full entry point on a
     (data, model) mesh with the chunked ppermute rings (a transformer
-    model; the flag reaches the projections via Context.matmul)."""
+    model; the flag reaches the projections via Context.matmul).
+
+    `slow` (tier-1 budget: the suite's single heaviest test, ~45 s of
+    BERT jit on this host): the ring math keeps engine-level parity
+    coverage in tier-1 (tests/test_collective_matmul.py), the lowering
+    keeps its HLO pins (tests/test_collectives_hlo.py), the flag
+    surface keeps its guards below, and the dryrun runs a
+    tensor_parallel_collective_matmul leg every round."""
     monkeypatch.chdir(tmp_path)
     result = data_parallel.main([
         "--engine", "tp", "--model-shards", "4",
@@ -109,9 +117,101 @@ def test_collective_matmul_flag_guards():
         ])
 
 
+def test_data_parallel_cli_ddp_bucketed_hierarchical(
+    tmp_path, monkeypatch
+):
+    """--engine ddp --grad-reduction bucketed --dcn-slices 2 drives the
+    full entry point on the hybrid dcn×ici mesh with the flat-bucket
+    ring reducer."""
+    monkeypatch.chdir(tmp_path)
+    result = data_parallel.main([
+        "--engine", "ddp", "--grad-reduction", "bucketed",
+        "--bucket-mb", "0.25", "--dcn-slices", "2",
+        "--model", "tinycnn",
+        "-type", "Synthetic", "-b", "64", "--val-batch-size", "128",
+        "--epochs", "1", "--steps-per-epoch", "2",
+    ])
+    assert len(result["history"]) == 1
+
+
+def test_grad_reduction_flag_guards():
+    """Defaults stay monolithic/1-slice everywhere; misuse fails loudly
+    instead of silently doing nothing."""
+    from distributed_model_parallel_tpu.cli import lm
+
+    dp_args = data_parallel.build_parser().parse_args([])
+    assert dp_args.grad_reduction == "monolithic"
+    # bucket_mb parses as a None sentinel ("flag not passed");
+    # check_grad_reduction_args resolves it to the 25 MB default.
+    assert dp_args.dcn_slices == 1 and dp_args.bucket_mb is None
+    lm_args = lm.build_parser().parse_args([])
+    assert lm_args.grad_reduction == "monolithic"
+    with pytest.raises(SystemExit):  # gspmd jit has no explicit site
+        data_parallel.main([
+            "--grad-reduction", "bucketed", "--model", "tinycnn",
+            "-type", "Synthetic",
+        ])
+    with pytest.raises(SystemExit):  # --bucket-mb is bucketed-only
+        data_parallel.main([
+            "--engine", "ddp", "--bucket-mb", "5", "--model",
+            "tinycnn", "-type", "Synthetic",
+        ])
+    with pytest.raises(SystemExit):  # even typed at the default value
+        data_parallel.main([
+            "--engine", "ddp", "--bucket-mb", "25", "--model",
+            "tinycnn", "-type", "Synthetic",
+        ])
+    with pytest.raises(SystemExit):  # --dcn-slices not under tp
+        data_parallel.main([
+            "--engine", "tp", "--dcn-slices", "2",
+            "--model", "bert_tiny", "-type", "SyntheticText",
+        ])
+    with pytest.raises(SystemExit):  # nonpositive bucket cap
+        data_parallel.main([
+            "--engine", "ddp", "--grad-reduction", "bucketed",
+            "--bucket-mb", "0", "--model", "tinycnn",
+            "-type", "Synthetic",
+        ])
+    with pytest.raises(SystemExit):  # pipeline mode reduces over wires
+        lm.main([
+            "--pipeline-stages", "2", "--grad-reduction", "bucketed",
+        ])
+    # dcn must divide the data axis (mesh-construction ValueError —
+    # loud, with the dcn vocabulary, before any training work)
+    with pytest.raises(ValueError, match="dcn"):
+        data_parallel.main([
+            "--engine", "ddp", "--dcn-slices", "3",
+            "--model", "tinycnn", "-type", "Synthetic",
+        ])
+
+
+@pytest.mark.slow
+def test_lm_cli_bucketed(tmp_path, monkeypatch):
+    """The lm CLI's --grad-reduction bucketed reaches the causal-LM
+    sequence-parallel engine end-to-end (seq rings + data buckets;
+    slow twin — the tier-1 reducer CLI coverage is the data_parallel
+    bucketed-hierarchical row above)."""
+    from distributed_model_parallel_tpu.cli import lm
+
+    monkeypatch.chdir(tmp_path)
+    result = lm.main([
+        "--seq-shards", "2", "--grad-reduction", "bucketed",
+        "--bucket-mb", "0.25", "--dcn-slices", "2",
+        "--dim", "32", "--layers", "2", "--heads", "4",
+        "--ffn-dim", "64", "--seq-len", "32",
+        "-b", "8", "--epochs", "1", "--steps-per-epoch", "2",
+        "--corpus-tokens", "4096", "--lr", "1e-3",
+    ])
+    assert len(result["history"]) == 1
+
+
+@pytest.mark.slow
 def test_lm_cli_collective_matmul(tmp_path, monkeypatch):
     """The lm CLI's --collective-matmul reaches the sequence-parallel
-    engine's FFN rings end-to-end."""
+    engine's FFN rings end-to-end. `slow` (tier-1 budget): engine-level
+    ring parity stays in tier-1 via
+    tests/test_collective_matmul.py::test_lm_sp_collective_matmul_
+    matches_ring_engine, and the flag guards above stay."""
     from distributed_model_parallel_tpu.cli import lm
 
     monkeypatch.chdir(tmp_path)

@@ -384,6 +384,155 @@ def test_fsdp_step_gathers_weights_and_reduce_scatters_grads():
                 or "dynamic_slice_sizes={128,16}" in hlo)
 
 
+# ------------------------------------------- bucketed grad reduction
+# The DDP-Reducer path (`ops/grad_reduction.py`): an opted-in step must
+# reduce gradients through per-bucket chunked rings — 2(S-1)
+# collective-permutes per bucket (reduce-scatter + all-gather) — with
+# NO monolithic grad-sized all-reduce over the full data axis left in
+# the program. Scalar all-reduces (the metrics psums) are allowed; the
+# pin distinguishes them by result shape.
+
+
+def _nonscalar_all_reduce_count(hlo: str) -> int:
+    """all-reduce ops whose RESULT carries at least one non-scalar
+    buffer — gradient-sized reductions, as opposed to the scalar
+    metrics psums every engine legitimately keeps."""
+    n = 0
+    for m in re.finditer(
+        rf"= ({_RESULT}) all-reduce(?:-start)?\(", hlo
+    ):
+        if re.search(r"\[\d", m.group(1)):
+            n += 1
+    return n
+
+
+def _mlp():
+    """BN-free classifier: model_state is empty, so the only all-reduces
+    a DDP step may contain are the gradient reduction and the scalar
+    metrics psums — the pin isolates the reducer."""
+    from distributed_model_parallel_tpu.models import layers as L
+
+    return L.sequential(
+        L.flatten(),
+        L.linear(192, 64),
+        L.relu(),
+        L.linear(64, 64),
+        L.relu(),
+        L.linear(64, 4),
+    )
+
+
+def _n_buckets(engine, bucket_mb):
+    from distributed_model_parallel_tpu.ops.grad_reduction import (
+        plan_buckets,
+    )
+
+    key_aval = jax.ShapeDtypeStruct((2,), jnp.uint32)
+    p_aval, _ = jax.eval_shape(engine.model.init, key_aval)
+    return len(
+        plan_buckets(jax.tree_util.tree_leaves(p_aval), bucket_mb)
+    )
+
+
+def test_ddp_bucketed_step_rings_instead_of_monolithic_all_reduce():
+    """Plain ('data',) mesh, S=8: the opted-in step carries exactly
+    2(S-1) permutes per bucket and ZERO grad-sized all-reduces; the
+    monolithic twin keeps its fused grad all-reduce and no rings."""
+    from distributed_model_parallel_tpu.parallel.data_parallel import (
+        DDPEngine,
+    )
+
+    mesh = make_mesh(MeshSpec(data=8))
+    bucket_mb = 0.02
+    hlos = {}
+    for gr in ("monolithic", "bucketed"):
+        eng = DDPEngine(
+            _mlp(), SGD(), mesh, donate=False,
+            grad_reduction=gr, bucket_mb=bucket_mb,
+        )
+        ts = eng.init_state(jax.random.PRNGKey(0))
+        im, lb = eng.shard_batch(*_batch(16))
+        hlos[gr] = _hlo(eng, ts, im, lb, jnp.float32(0.1))
+        if gr == "bucketed":
+            n_buckets = _n_buckets(eng, bucket_mb)
+
+    assert n_buckets >= 2  # the cap actually split the pytree
+    c = _collective_counts(hlos["bucketed"])
+    assert c["collective-permute"] == 2 * (8 - 1) * n_buckets
+    assert c["all-gather"] == 0 and c["reduce-scatter"] == 0
+    assert _nonscalar_all_reduce_count(hlos["bucketed"]) == 0
+
+    c_mono = _collective_counts(hlos["monolithic"])
+    assert c_mono["collective-permute"] == 0
+    assert _nonscalar_all_reduce_count(hlos["monolithic"]) >= 1
+
+
+def test_ddp_bucketed_hybrid_step_one_dcn_all_reduce_per_bucket():
+    """2×4 dcn×ici mesh: per bucket, 2(ici-1) ring permutes plus ONE
+    cross-slice all-reduce — carrying only the 1/ici shard, pinned by
+    its result bytes — and nothing grad-sized beyond those."""
+    from distributed_model_parallel_tpu.ops.grad_reduction import (
+        plan_buckets,
+    )
+    from distributed_model_parallel_tpu.parallel.data_parallel import (
+        DDPEngine,
+    )
+
+    mesh = make_mesh(MeshSpec(data=8, dcn=2))
+    bucket_mb = 0.02
+    eng = DDPEngine(
+        _mlp(), SGD(), mesh, donate=False,
+        grad_reduction="bucketed", bucket_mb=bucket_mb,
+    )
+    ts = eng.init_state(jax.random.PRNGKey(0))
+    im, lb = eng.shard_batch(*_batch(16))
+    hlo = _hlo(eng, ts, im, lb, jnp.float32(0.1))
+
+    key_aval = jax.ShapeDtypeStruct((2,), jnp.uint32)
+    p_aval, _ = jax.eval_shape(eng.model.init, key_aval)
+    buckets = plan_buckets(
+        jax.tree_util.tree_leaves(p_aval), bucket_mb
+    )
+    assert len(buckets) >= 2
+    c = _collective_counts(hlo)
+    assert c["collective-permute"] == 2 * (4 - 1) * len(buckets)
+    assert c["all-gather"] == 0 and c["reduce-scatter"] == 0
+    # one cross-slice (dcn) all-reduce per bucket — the only
+    # non-scalar all-reduces in the step...
+    assert _nonscalar_all_reduce_count(hlo) == len(buckets)
+    # ...and each carries the bucket's 1/ici shard, not the full bucket.
+    for b in buckets:
+        padded = b.size + (-b.size % 4)
+        assert _has_op_with_result(
+            hlo, "all-reduce", f"f32[{padded // 4}]"
+        ), (b.size, padded)
+
+
+def test_fsdp_bucketed_step_gathers_weights_and_rings_grads():
+    """The explicit bucketed-FSDP step: per-leaf weight all-gathers on
+    entry (the ZeRO-3 collective, now explicit) and per-bucket ring
+    permutes for the gradients — no grad-sized all-reduce, no
+    monolithic reduce-scatter."""
+    from distributed_model_parallel_tpu.parallel.fsdp import FSDPEngine
+
+    mesh = make_mesh(MeshSpec(data=8))
+    bucket_mb = 0.02
+    eng = FSDPEngine(
+        _mlp(), SGD(), mesh, donate=False, min_shard_elems=64,
+        grad_reduction="bucketed", bucket_mb=bucket_mb,
+    )
+    ts = eng.init_state(jax.random.PRNGKey(0))
+    im, lb = eng.shard_batch(*_batch(1024))
+    hlo = _hlo(eng, ts, im, lb, jnp.float32(0.1))
+    n_buckets = _n_buckets(eng, bucket_mb)
+
+    c = _collective_counts(hlo)
+    assert c["all-gather"] >= 1  # sharded weights materialize per leaf
+    assert c["collective-permute"] == 2 * (8 - 1) * n_buckets
+    assert c["reduce-scatter"] == 0
+    assert _nonscalar_all_reduce_count(hlo) == 0
+
+
 def test_sp_ulysses_step_contains_all_to_all():
     from distributed_model_parallel_tpu.models.bert import BertConfig
     from distributed_model_parallel_tpu.parallel.sequence_parallel import (

@@ -50,6 +50,86 @@ def test_fsdp_specs_policy():
     assert specs["tiny"] == P()
 
 
+def test_fsdp_specs_no_divisible_dim_replicates_even_when_large():
+    """A leaf whose every dimension resists the shard count stays
+    replicated no matter how big it is — sharding must never round."""
+    from jax.sharding import PartitionSpec as P
+
+    avals = {
+        "prime3d": jax.ShapeDtypeStruct((31, 37, 41), jnp.float32),
+        # one divisible dim buried as the SMALLEST: still found
+        "small_div": jax.ShapeDtypeStruct((8, 35, 33), jnp.float32),
+    }
+    specs = fsdp_specs(avals, 8)
+    assert specs["prime3d"] == P()
+    assert specs["small_div"] == P("data", None, None)
+
+
+def test_fsdp_specs_min_shard_elems_boundary_is_inclusive():
+    """prod(shape) == min_shard_elems shards; one element fewer
+    replicates (the `< min_shard_elems` cut, pinned both sides)."""
+    from jax.sharding import PartitionSpec as P
+
+    avals = {
+        "at": jax.ShapeDtypeStruct((32, 32), jnp.float32),    # 1024
+        "under": jax.ShapeDtypeStruct((32, 31), jnp.float32),  # 992
+        "scalar": jax.ShapeDtypeStruct((), jnp.float32),
+    }
+    specs = fsdp_specs(avals, 8, min_shard_elems=1024)
+    assert specs["at"] == P(("data",), None) or specs["at"] == P(
+        "data", None
+    )
+    assert specs["under"] == P()
+    assert specs["scalar"] == P()
+
+
+def test_fsdp_specs_prefers_largest_divisible_dim():
+    from jax.sharding import PartitionSpec as P
+
+    avals = {"w": jax.ShapeDtypeStruct((16, 64), jnp.float32)}
+    assert fsdp_specs(avals, 8, min_shard_elems=64)["w"] == P(
+        None, "data"
+    )
+
+
+def test_fsdp_specs_hybrid_axes_entry():
+    """On a hybrid mesh the sharded dim carries the ('dcn', 'ici')
+    tuple — one dim split over both fabrics."""
+    from jax.sharding import PartitionSpec as P
+
+    avals = {"w": jax.ShapeDtypeStruct((64, 3), jnp.float32)}
+    specs = fsdp_specs(
+        avals, 8, min_shard_elems=64, axes=("dcn", "ici")
+    )
+    assert specs["w"] == P(("dcn", "ici"), None)
+
+
+def test_fsdp_state_shardings_follow_param_spec_for_adamw_moments():
+    """AdamW's mu/nu must shard exactly like their parameters (the
+    `state_shardings` protocol) — and the bias-correction step count
+    stays replicated."""
+    from jax.sharding import PartitionSpec as P
+
+    mesh = make_mesh(MeshSpec(data=8))
+    eng = FSDPEngine(
+        tiny_cnn(10), AdamW(), mesh, donate=False, min_shard_elems=64
+    )
+    sh = eng._state_sh
+    flat_p = jax.tree_util.tree_leaves_with_path(sh.params)
+    for moments in (sh.opt_state.mu, sh.opt_state.nu):
+        flat_m = jax.tree_util.tree_leaves(moments)
+        assert len(flat_m) == len(flat_p)
+        for (path, psh), msh in zip(flat_p, flat_m):
+            assert msh.spec == psh.spec, jax.tree_util.keystr(path)
+    assert sh.opt_state.count.spec == P()
+    # and at least one moment really is sharded (not all-replicated)
+    assert any(
+        sh_.spec != P() for sh_ in jax.tree_util.tree_leaves(
+            sh.opt_state.mu
+        )
+    )
+
+
 def test_fsdp_matches_dp_trajectory():
     mesh = make_mesh(MeshSpec(data=8))
     model = tiny_cnn(10)

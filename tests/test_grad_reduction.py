@@ -1,0 +1,479 @@
+"""Bucketed hierarchical gradient reduction (`ops/grad_reduction.py`):
+bucket-plan unit tests, ring reduce-scatter/all-gather parity with the
+monolithic collectives, `bucketed_pmean` == `lax.pmean` on mixed-dtype
+and uneven-tail pytrees, and engine-level parity — DDP / FSDP / causal-
+LM SP with `grad_reduction="bucketed"` must match their monolithic
+twins at rtol 1e-5 (grads, metrics, 3-step trajectory) for S ∈ {2, 4,
+8}, including the 2×(S/2) dcn×ici factored mesh."""
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+from distributed_model_parallel_tpu.models.tinycnn import tiny_cnn
+from distributed_model_parallel_tpu.ops.grad_reduction import (
+    bucketed_pmean,
+    data_replica_index,
+    plan_buckets,
+    ring_all_gather,
+    ring_reduce_scatter,
+)
+from distributed_model_parallel_tpu.parallel.data_parallel import DDPEngine
+from distributed_model_parallel_tpu.runtime.compat import shard_map
+from distributed_model_parallel_tpu.runtime.mesh import (
+    MeshSpec,
+    data_axis_names,
+    data_axis_size,
+    make_mesh,
+)
+from distributed_model_parallel_tpu.training.optim import SGD
+
+
+# ------------------------------------------------------- bucket plan
+
+
+def _avals(*shaped):
+    return [jax.ShapeDtypeStruct(s, d) for s, d in shaped]
+
+
+def test_plan_buckets_reverse_registration_order():
+    """Late leaves land in the FIRST bucket (backprop produces them
+    first — the Reducer's launch-order trick)."""
+    leaves = _avals(
+        ((256,), jnp.float32), ((256,), jnp.float32), ((256,), jnp.float32)
+    )
+    buckets = plan_buckets(leaves, bucket_mb=2 * 256 * 4 / (1 << 20))
+    assert [s.index for b in buckets for s in b.slots] == [2, 1, 0]
+    assert [len(b.slots) for b in buckets] == [2, 1]
+    # offsets are contiguous within a bucket
+    assert [(s.offset, s.size) for s in buckets[0].slots] == [
+        (0, 256), (256, 256)
+    ]
+
+
+def test_plan_buckets_groups_by_dtype():
+    """bf16 and f32 leaves never share a flat buffer."""
+    leaves = _avals(
+        ((64,), jnp.float32), ((64,), jnp.bfloat16), ((64,), jnp.float32)
+    )
+    buckets = plan_buckets(leaves, bucket_mb=25.0)
+    assert len(buckets) == 2
+    by_dtype = {b.dtype: [s.index for s in b.slots] for b in buckets}
+    assert by_dtype[jnp.dtype(jnp.float32)] == [2, 0]
+    assert by_dtype[jnp.dtype(jnp.bfloat16)] == [1]
+
+
+def test_plan_buckets_oversized_leaf_gets_own_bucket():
+    """A leaf past bucket_mb sits alone in its bucket; buckets stay
+    CONTIGUOUS ranges of the reverse order (Reducer semantics — the
+    tiny leaves flanking the giant one do not leapfrog it to share)."""
+    leaves = _avals(
+        ((8,), jnp.float32), ((1 << 20,), jnp.float32), ((8,), jnp.float32)
+    )
+    buckets = plan_buckets(leaves, bucket_mb=0.5)
+    assert [[s.index for s in b.slots] for b in buckets] == [
+        [2], [1], [0]
+    ]
+    assert buckets[1].size == 1 << 20
+
+
+def test_plan_buckets_rejects_integer_leaves():
+    with pytest.raises(TypeError, match="non-floating"):
+        plan_buckets(_avals(((4,), jnp.int32)), bucket_mb=1.0)
+
+
+def test_plan_buckets_rejects_nonpositive_cap():
+    with pytest.raises(ValueError, match="bucket_mb"):
+        plan_buckets(_avals(((4,), jnp.float32)), bucket_mb=0.0)
+
+
+# ------------------------------------------------- ring collectives
+
+
+@pytest.mark.parametrize("size", [2, 3, 4, 8])
+def test_ring_reduce_scatter_matches_psum_scatter(size, devices):
+    """Each shard holds a distinct flat (n,) vector; both lowerings
+    must produce the same summed 1/S chunks."""
+    mesh = Mesh(np.array(devices[:size]), ("d",))
+    n = 6 * size
+    rng = np.random.RandomState(0)
+    x = jnp.asarray(rng.randn(size * n), jnp.float32)
+
+    def run(fn):
+        m = shard_map(
+            fn, mesh=mesh, in_specs=(P("d"),), out_specs=P("d"),
+            check_vma=False,
+        )
+        return np.asarray(jax.jit(m)(x))
+
+    ring = run(partial(ring_reduce_scatter, axis_name="d"))
+    mono = run(
+        lambda v: lax.psum_scatter(v, "d", scatter_dimension=0,
+                                   tiled=True)
+    )
+    np.testing.assert_allclose(ring, mono, rtol=1e-5, atol=1e-6)
+
+
+@pytest.mark.parametrize("size", [2, 3, 4, 8])
+def test_ring_all_gather_matches_all_gather(size, devices):
+    mesh = Mesh(np.array(devices[:size]), ("d",))
+    x = jnp.arange(size * 5, dtype=jnp.float32)
+
+    def run(fn):
+        m = shard_map(
+            fn, mesh=mesh, in_specs=(P("d"),), out_specs=P(None),
+            check_vma=False,
+        )
+        return np.asarray(jax.jit(m)(x))
+
+    ring = run(partial(ring_all_gather, axis_name="d"))
+    mono = run(lambda v: lax.all_gather(v, "d", axis=0, tiled=True))
+    np.testing.assert_allclose(ring, mono)
+
+
+# ------------------------------------------- bucketed_pmean (pytree)
+
+
+def _grad_tree(rng):
+    """Mixed-dtype pytree with awkward (prime) sizes so every bucket
+    has an uneven tail against the ring size."""
+    return {
+        "w1": jnp.asarray(rng.randn(13, 7), jnp.float32),
+        "b1": jnp.asarray(rng.randn(7), jnp.float32),
+        "w2": jnp.asarray(rng.randn(31, 3), jnp.bfloat16),
+        "scalar": jnp.asarray(rng.randn(), jnp.float32),
+        "w3": jnp.asarray(rng.randn(97), jnp.float32),
+    }
+
+
+@pytest.mark.parametrize(
+    "mesh_shape, axes",
+    [
+        ((2,), ("data",)),
+        pytest.param((4,), ("data",), marks=pytest.mark.slow),
+        ((8,), ("data",)),
+        pytest.param((2, 2), ("dcn", "ici"), marks=pytest.mark.slow),
+        ((2, 4), ("dcn", "ici")),
+    ],
+)
+def test_bucketed_pmean_matches_lax_pmean(mesh_shape, axes, devices):
+    size = int(np.prod(mesh_shape))
+    mesh = Mesh(np.array(devices[:size]).reshape(mesh_shape), axes)
+    ici = axes[-1]
+    dcn = axes[0] if len(axes) > 1 else None
+    rngs = [np.random.RandomState(i) for i in range(size)]
+    # One distinct tree per shard, stacked on a leading device axis.
+    trees = [_grad_tree(r) for r in rngs]
+    stacked = jax.tree_util.tree_map(
+        lambda *xs: jnp.stack(xs).reshape(
+            mesh_shape + xs[0].shape
+        ),
+        *trees,
+    )
+    in_spec = jax.tree_util.tree_map(
+        lambda _: P(*axes), stacked
+    )
+
+    def run(fn):
+        def body(t):
+            squeezed = jax.tree_util.tree_map(
+                lambda x: x.reshape(x.shape[len(mesh_shape):]), t
+            )
+            out = fn(squeezed)
+            return jax.tree_util.tree_map(
+                lambda x: x.reshape((1,) * len(mesh_shape) + x.shape),
+                out,
+            )
+
+        m = shard_map(
+            body, mesh=mesh, in_specs=(in_spec,), out_specs=in_spec,
+            check_vma=False,
+        )
+        # every shard's result is identical; read shard (0, ..., 0)
+        return jax.tree_util.tree_map(
+            lambda x: np.asarray(x)[(0,) * len(mesh_shape)],
+            jax.jit(m)(stacked),
+        )
+
+    mono = run(lambda t: lax.pmean(t, axes))
+    # Tiny bucket cap → multiple buckets, exercising tails and order.
+    buck = run(
+        lambda t: bucketed_pmean(t, ici, dcn, bucket_mb=0.0005)
+    )
+    for k in mono:
+        a, b = np.asarray(mono[k], np.float32), np.asarray(
+            buck[k], np.float32
+        )
+        if trees[0][k].dtype == jnp.bfloat16:
+            # bf16 carries ~8 mantissa bits; S-term sums in a different
+            # order can disagree by a few ULPs of the PARTIAL sums.
+            np.testing.assert_allclose(a, b, rtol=5e-2, atol=2e-2)
+        else:
+            np.testing.assert_allclose(a, b, rtol=1e-5, atol=1e-7)
+
+
+def test_data_replica_index_is_dcn_major(devices):
+    mesh = Mesh(np.array(devices).reshape(2, 4), ("dcn", "ici"))
+    fn = jax.jit(shard_map(
+        lambda x: x * 0 + data_replica_index(("dcn", "ici")),
+        mesh=mesh, in_specs=(P(("dcn", "ici")),),
+        out_specs=P(("dcn", "ici")), check_vma=False,
+    ))
+    out = np.asarray(fn(jnp.zeros(8, jnp.int32)))
+    np.testing.assert_array_equal(out, np.arange(8))
+
+
+# ------------------------------------------------- hybrid mesh layer
+
+
+def test_make_mesh_dcn_factors_the_data_axis(devices):
+    mesh = make_mesh(MeshSpec(data=8, dcn=2))
+    assert mesh.axis_names[:2] == ("dcn", "ici")
+    assert "data" not in mesh.axis_names
+    assert mesh.shape["dcn"] == 2 and mesh.shape["ici"] == 4
+    assert data_axis_names(mesh) == ("dcn", "ici")
+    assert data_axis_size(mesh) == 8
+    plain = make_mesh(MeshSpec(data=8))
+    assert data_axis_names(plain) == ("data",)
+    assert data_axis_size(plain) == 8
+
+
+def test_make_mesh_dcn_must_divide_data():
+    with pytest.raises(ValueError, match="dcn"):
+        make_mesh(MeshSpec(data=8, dcn=3))
+    with pytest.raises(ValueError, match="dcn"):
+        MeshSpec(data=8, dcn=0).resolve(8)
+
+
+def test_make_mesh_dcn_resolves_wildcard_data(devices):
+    mesh = make_mesh(MeshSpec(data=-1, dcn=4))
+    assert mesh.shape["dcn"] == 4 and mesh.shape["ici"] == 2
+
+
+# ------------------------------------------------ engine-level parity
+
+
+def _batch(n=16):
+    rng = np.random.RandomState(7)
+    return (
+        rng.rand(n, 8, 8, 3).astype(np.float32),
+        rng.randint(0, 10, size=(n,)).astype(np.int32),
+    )
+
+
+def _run(eng, n_steps=3, lr=0.05):
+    ts = eng.init_state(jax.random.PRNGKey(0))
+    x, y = eng.shard_batch(*_batch())
+    traj, metrics = [], None
+    for _ in range(n_steps):
+        ts, metrics = eng.train_step(ts, x, y, jnp.float32(lr))
+        traj.append(float(metrics["loss_sum"]))
+    return ts, traj, metrics
+
+
+def _tree_close(a, b, rtol=1e-5, atol=1e-6):
+    for x, y in zip(
+        jax.tree_util.tree_leaves(a), jax.tree_util.tree_leaves(b)
+    ):
+        np.testing.assert_allclose(
+            np.asarray(x), np.asarray(y), rtol=rtol, atol=atol
+        )
+
+
+def _spec_for(s, dcn):
+    return MeshSpec(data=s, dcn=dcn)
+
+
+# Engine-parity sweeps: the full S ∈ {2, 4, 8} matrix runs under the
+# `slow` marker (each case compiles two engines on the 1-core host);
+# tier-1 keeps the S=8 smoke of each family — the same assertions on
+# the largest mesh (the repo's established sweep-vs-smoke pattern,
+# tests/test_pipeline_schedule.py).
+_S_SWEEP = [
+    pytest.param(2, marks=pytest.mark.slow),
+    pytest.param(4, marks=pytest.mark.slow),
+    8,
+]
+
+
+@pytest.mark.parametrize("s", _S_SWEEP)
+def test_ddp_bucketed_matches_monolithic(s, devices):
+    """Grads (via the first-step update), metrics, and the 3-step
+    trajectory — rtol 1e-5, plain ('data',) mesh."""
+    mesh = make_mesh(MeshSpec(data=s), devices=devices[:s])
+    results = {}
+    for gr in ("monolithic", "bucketed"):
+        eng = DDPEngine(
+            tiny_cnn(10), SGD(), mesh, donate=False,
+            grad_reduction=gr, bucket_mb=0.02,
+        )
+        results[gr] = _run(eng)
+    ts_m, traj_m, met_m = results["monolithic"]
+    ts_b, traj_b, met_b = results["bucketed"]
+    np.testing.assert_allclose(traj_b, traj_m, rtol=1e-5)
+    _tree_close(ts_b.params, ts_m.params)
+    _tree_close(met_b, met_m, rtol=1e-5, atol=1e-5)
+
+
+@pytest.mark.parametrize("s", _S_SWEEP)
+def test_ddp_bucketed_matches_monolithic_on_hybrid_mesh(s, devices):
+    """Same parity on the 2×(S/2) dcn×ici factored mesh — the
+    hierarchical path (ici rings + dcn all-reduce)."""
+    mesh = make_mesh(
+        MeshSpec(data=s, dcn=2), devices=devices[:s]
+    )
+    results = {}
+    for gr in ("monolithic", "bucketed"):
+        eng = DDPEngine(
+            tiny_cnn(10), SGD(), mesh, donate=False,
+            grad_reduction=gr, bucket_mb=0.02,
+        )
+        results[gr] = _run(eng)
+    np.testing.assert_allclose(
+        results["bucketed"][1], results["monolithic"][1], rtol=1e-5
+    )
+    _tree_close(results["bucketed"][0].params,
+                results["monolithic"][0].params)
+    _tree_close(results["bucketed"][2], results["monolithic"][2],
+                rtol=1e-5, atol=1e-5)
+
+
+@pytest.mark.slow
+def test_ddp_bucketed_first_step_grads_match(devices):
+    """Isolate the GRADIENTS: with momentum/wd off, the first-step
+    param delta is -lr * grads; pin it between the two reducers.
+    (`slow`: the tier-1 S=8 smokes above pin grads implicitly through
+    the 3-step trajectory at the same tolerance.)"""
+    mesh = make_mesh(MeshSpec(data=8))
+    deltas = {}
+    for gr in ("monolithic", "bucketed"):
+        eng = DDPEngine(
+            tiny_cnn(10), SGD(momentum=0.0, weight_decay=0.0), mesh,
+            donate=False, grad_reduction=gr, bucket_mb=0.02,
+        )
+        ts0 = eng.init_state(jax.random.PRNGKey(0))
+        x, y = eng.shard_batch(*_batch())
+        ts1, _ = eng.train_step(ts0, x, y, jnp.float32(1.0))
+        deltas[gr] = jax.tree_util.tree_map(
+            lambda a, b: np.asarray(b) - np.asarray(a),
+            ts0.params, ts1.params,
+        )
+    _tree_close(deltas["bucketed"], deltas["monolithic"])
+
+
+@pytest.mark.slow
+def test_ddp_bucketed_hybrid_matches_plain_mesh_trajectory(devices):
+    """The dcn×ici factoring is a LAYOUT, not math: the bucketed
+    trajectory on the hybrid mesh equals the plain-mesh one."""
+    plain = make_mesh(MeshSpec(data=8))
+    hybrid = make_mesh(MeshSpec(data=8, dcn=2))
+    trajs = {}
+    for name, mesh in (("plain", plain), ("hybrid", hybrid)):
+        eng = DDPEngine(
+            tiny_cnn(10), SGD(), mesh, donate=False,
+            grad_reduction="bucketed", bucket_mb=0.02,
+        )
+        trajs[name] = _run(eng)[1]
+    np.testing.assert_allclose(trajs["hybrid"], trajs["plain"],
+                               rtol=1e-5)
+
+
+# The hybrid mesh (covering both fabrics) is the tier-1 smoke; the
+# plain-mesh twin rides the slow sweep.
+_MESH_SWEEP = [
+    pytest.param(1, marks=pytest.mark.slow),
+    2,
+]
+
+
+@pytest.mark.parametrize("dcn", _MESH_SWEEP)
+def test_fsdp_bucketed_matches_monolithic_and_stays_sharded(
+    dcn, devices
+):
+    """The explicit bucketed-reduce-scatter FSDP step: trajectory
+    parity with the declarative engine AND 1/N physical sharding of
+    params + moments preserved."""
+    from distributed_model_parallel_tpu.parallel.fsdp import FSDPEngine
+    from distributed_model_parallel_tpu.training.optim import AdamW
+
+    for spec in (MeshSpec(data=8, dcn=dcn),):
+        mesh = make_mesh(spec)
+        res = {}
+        for gr in ("monolithic", "bucketed"):
+            eng = FSDPEngine(
+                tiny_cnn(10), AdamW(), mesh, donate=False,
+                min_shard_elems=64, grad_reduction=gr, bucket_mb=0.02,
+            )
+            res[gr] = _run(eng, lr=1e-3)
+        np.testing.assert_allclose(
+            res["bucketed"][1], res["monolithic"][1], rtol=1e-5
+        )
+        _tree_close(res["bucketed"][0].params,
+                    res["monolithic"][0].params)
+        big = max(
+            jax.tree_util.tree_leaves(res["bucketed"][0].params),
+            key=lambda l: l.size,
+        )
+        assert np.prod(big.addressable_shards[0].data.shape) == (
+            big.size // 8
+        )
+        mu = max(
+            jax.tree_util.tree_leaves(res["bucketed"][0].opt_state.mu),
+            key=lambda l: l.size,
+        )
+        assert np.prod(mu.addressable_shards[0].data.shape) == (
+            mu.size // 8
+        )
+
+
+@pytest.mark.parametrize("dcn", _MESH_SWEEP)
+def test_causal_lm_sp_bucketed_matches_monolithic(dcn, devices):
+    """The lm CLI's engine: bucketed data-axis reduction (after the
+    'seq' psum) matches the fused psum path, plain and hybrid."""
+    from distributed_model_parallel_tpu.models.gpt import GPTConfig
+    from distributed_model_parallel_tpu.parallel.sequence_parallel import (
+        CausalLMSequenceParallelEngine,
+    )
+    from distributed_model_parallel_tpu.training.optim import AdamW
+
+    cfg = GPTConfig(
+        vocab_size=64, dim=32, num_layers=1, num_heads=4, ffn_dim=64,
+        max_position=32, dropout_rate=0.0, pad_token_id=0,
+    )
+    rng = np.random.RandomState(0)
+    ids = rng.randint(1, 64, size=(8, 32)).astype(np.int32)
+    for spec in (MeshSpec(data=4, seq=2, dcn=dcn),):
+        mesh = make_mesh(spec)
+        res = {}
+        for gr in ("monolithic", "bucketed"):
+            eng = CausalLMSequenceParallelEngine(
+                cfg, AdamW(), mesh, donate=False,
+                grad_reduction=gr, bucket_mb=0.02,
+            )
+            ts = eng.init_state(jax.random.PRNGKey(0))
+            a, b = eng.shard_batch(ids)
+            traj = []
+            for _ in range(3):
+                ts, m = eng.train_step(ts, a, b, jnp.float32(1e-3))
+                traj.append(float(m["loss_sum"]))
+            res[gr] = (ts, traj)
+        np.testing.assert_allclose(
+            res["bucketed"][1], res["monolithic"][1], rtol=1e-5
+        )
+        _tree_close(res["bucketed"][0].params,
+                    res["monolithic"][0].params, rtol=1e-4)
+
+
+def test_engine_rejects_unknown_grad_reduction(devices):
+    mesh = make_mesh(MeshSpec(data=8))
+    with pytest.raises(ValueError, match="grad_reduction"):
+        DDPEngine(tiny_cnn(10), SGD(), mesh, grad_reduction="fused")
+
+
+if __name__ == "__main__":
+    raise SystemExit(pytest.main([__file__, "-v"]))

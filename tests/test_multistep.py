@@ -1,0 +1,128 @@
+"""Direct unit tests for training/multistep.py — previously covered
+only indirectly through the trainer/pipeline suites: `group_batches`
+trailing-partial-group behavior and `compile_multi_step`'s k=1
+passthrough parity with the engine's own step."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from distributed_model_parallel_tpu.models.tinycnn import tiny_cnn
+from distributed_model_parallel_tpu.parallel.data_parallel import DDPEngine
+from distributed_model_parallel_tpu.runtime.mesh import MeshSpec, make_mesh
+from distributed_model_parallel_tpu.training.multistep import (
+    compile_multi_eval,
+    compile_multi_step,
+    group_batches,
+)
+from distributed_model_parallel_tpu.training.optim import SGD
+
+
+# ------------------------------------------------------ group_batches
+
+
+def test_group_batches_full_groups_then_trailing_partial():
+    it = iter(range(10))
+    assert group_batches(it, 4) == [0, 1, 2, 3]
+    assert group_batches(it, 4) == [4, 5, 6, 7]
+    # The exhausted iterator yields the SHORT trailing group (the
+    # caller's per-step fallback path), then empties.
+    assert group_batches(it, 4) == [8, 9]
+    assert group_batches(it, 4) == []
+
+
+def test_group_batches_exact_multiple_has_no_phantom_group():
+    it = iter(range(8))
+    assert group_batches(it, 4) == [0, 1, 2, 3]
+    assert group_batches(it, 4) == [4, 5, 6, 7]
+    assert group_batches(it, 4) == []
+
+
+def test_group_batches_k_larger_than_stream():
+    assert group_batches(iter([1, 2]), 5) == [1, 2]
+
+
+# -------------------------------------------------- compile_multi_step
+
+
+def _engine_and_batches(n_batches, batch=16):
+    mesh = make_mesh(MeshSpec(data=8))
+    eng = DDPEngine(tiny_cnn(10), SGD(), mesh, donate=False)
+    rng = np.random.RandomState(0)
+    batches = []
+    for i in range(n_batches):
+        x = rng.rand(batch, 8, 8, 3).astype(np.float32)
+        y = rng.randint(0, 10, size=(batch,)).astype(np.int32)
+        batches.append(eng.shard_batch(x, y))
+    return eng, batches
+
+
+def _tree_allclose(a, b, rtol=1e-5, atol=1e-6):
+    for x, y in zip(
+        jax.tree_util.tree_leaves(a), jax.tree_util.tree_leaves(b)
+    ):
+        np.testing.assert_allclose(
+            np.asarray(x), np.asarray(y), rtol=rtol, atol=atol
+        )
+
+
+def test_compile_multi_step_k1_is_engine_step_passthrough():
+    """One-batch dispatch == one engine.train_step call: same params,
+    same step counter, same metrics (the trajectory-identity contract,
+    at its smallest k)."""
+    eng, batches = _engine_and_batches(1)
+    ts0 = eng.init_state(jax.random.PRNGKey(0))
+    lr = jnp.float32(0.05)
+
+    ts_direct, m_direct = eng.train_step(ts0, *batches[0], lr)
+
+    multi = compile_multi_step(eng, 1)
+    ts_multi, m_multi = multi(
+        eng.init_state(jax.random.PRNGKey(0)), tuple(batches), lr
+    )
+    assert int(ts_multi.step) == int(ts_direct.step) == 1
+    _tree_allclose(ts_multi.params, ts_direct.params)
+    _tree_allclose(m_multi, m_direct, rtol=1e-5, atol=1e-5)
+
+
+def test_compile_multi_step_k2_matches_two_sequential_steps():
+    eng, batches = _engine_and_batches(2)
+    lr = jnp.float32(0.05)
+
+    ts = eng.init_state(jax.random.PRNGKey(0))
+    summed = None
+    for b in batches:
+        ts, m = eng.train_step(ts, *b, lr)
+        summed = (
+            m if summed is None
+            else jax.tree_util.tree_map(jnp.add, summed, m)
+        )
+
+    multi = compile_multi_step(eng, 2)
+    ts_multi, m_multi = multi(
+        eng.init_state(jax.random.PRNGKey(0)), tuple(batches), lr
+    )
+    assert int(ts_multi.step) == 2
+    _tree_allclose(ts_multi.params, ts.params)
+    _tree_allclose(m_multi, summed, rtol=1e-5, atol=1e-5)
+
+
+def test_compile_multi_eval_k1_matches_engine_eval():
+    eng, batches = _engine_and_batches(1)
+    ts = eng.init_state(jax.random.PRNGKey(0))
+    m_direct = eng.eval_step(ts, *batches[0])
+    m_multi = compile_multi_eval(eng, 1)(ts, tuple(batches))
+    _tree_allclose(m_multi, m_direct, rtol=1e-5, atol=1e-5)
+
+
+def test_compile_multi_step_rejects_k0():
+    eng, _ = _engine_and_batches(1)
+    with pytest.raises(ValueError, match=">= 1"):
+        compile_multi_step(eng, 0)
+    with pytest.raises(ValueError, match=">= 1"):
+        compile_multi_eval(eng, 0)
+
+
+if __name__ == "__main__":
+    raise SystemExit(pytest.main([__file__, "-v"]))

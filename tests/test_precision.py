@@ -81,8 +81,12 @@ def test_ddp_bf16_close_to_f32():
     np.testing.assert_allclose(losses_bf16, losses_f32, rtol=5e-2)
 
 
+@pytest.mark.slow
 def test_pipeline_bf16_close_to_f32():
-    """4-stage pipeline, bf16 activations over the ppermute wire."""
+    """4-stage pipeline, bf16 activations over the ppermute wire.
+    `slow` (tier-1 budget): test_pipeline_bf16_stage_local_combo below
+    keeps the pipeline+bf16 wire coverage in tier-1 (same engine, plus
+    the stage-local layout)."""
     mesh = make_mesh(MeshSpec(data=2, stage=4))
     stages = tinycnn.split_stages(4, 10)
     f32 = PipelineEngine(

@@ -140,9 +140,14 @@ def test_gpt_pipeline_trains(pp_mesh):
     assert losses[-1] < losses[0], losses
 
 
+@pytest.mark.slow
 def test_model_parallel_cli_bert_tiny(tmp_path, monkeypatch):
     """The verdict's done criterion: `cli.model_parallel --model
-    bert_tiny --world-size 4` trains (SyntheticText, 4 stages)."""
+    bert_tiny --world-size 4` trains (SyntheticText, 4 stages).
+    `slow` (tier-1 budget, ~34 s): the BERT pipeline keeps tier-1
+    engine coverage via test_bert_pipeline_trains_on_text_task below
+    and test_bert.py's pipeline rows; the model_parallel CLI keeps its
+    tinycnn e2e rows in tests/test_cli.py."""
     from distributed_model_parallel_tpu.cli import model_parallel
 
     monkeypatch.chdir(tmp_path)
@@ -162,11 +167,15 @@ def test_model_parallel_cli_bert_tiny(tmp_path, monkeypatch):
     assert np.isfinite(result["history"][0]["train"]["loss"])
 
 
+@pytest.mark.slow
 def test_pipeline_engine_multi_step_dispatch(pp_mesh, tmp_path):
     """The engine path behind the model-parallel CLI's
     --steps-per-dispatch: Trainer folds PipelineEngine steps through
     compile_multi_step, so the k-step scan must trace the pipeline's
-    shard_map program (ppermute chains inside a scan body). The CLI
+    shard_map program (ppermute chains inside a scan body). `slow`
+    (tier-1 budget, ~20 s): the multistep-over-shard_map nesting keeps
+    tier-1 coverage via test_sp_engine_multi_step_dispatch below and
+    tests/test_multistep.py's DDP rows. The CLI
     flag plumbing itself is covered by
     test_model_parallel_cli_bert_tiny."""
     from distributed_model_parallel_tpu.data.datasets import (
@@ -227,9 +236,14 @@ def test_sp_engine_multi_step_dispatch():
     assert int(ts.step) == 2
 
 
+@pytest.mark.slow
 def test_lm_cli_pipeline_stages(tmp_path, monkeypatch):
     """GPT-LM pipeline drivable end to end from the LM CLI:
-    --pipeline-stages 4 builds gpt.split_stages + LMPipelineEngine."""
+    --pipeline-stages 4 builds gpt.split_stages + LMPipelineEngine.
+    `slow` (tier-1 budget): the LMPipelineEngine keeps its tier-1
+    engine coverage (test_gpt_pipeline_trains below + the lm_pipeline
+    dryrun leg every round); the CLI flag surface keeps its guards in
+    tests/test_cli.py."""
     from distributed_model_parallel_tpu.cli import lm as lm_cli
 
     monkeypatch.chdir(tmp_path)

@@ -13,8 +13,10 @@
 #   * the default marker filter (-m 'not slow', see pytest.ini) — the
 #     full S×V×M pipeline-schedule parity sweep is `slow`; tier-1 keeps
 #     its S=2,V=2,M=4 smoke case,
-#   * survives collection errors so one broken module can't hide the
-#     rest of the suite's result,
+#   * a fast `--collect-only` PRE-GATE so import/collection errors fail
+#     in seconds with the module named (exit 2), instead of surfacing
+#     mid-run; the main pass still carries
+#     --continue-on-collection-errors as a belt-and-braces backstop,
 #   * 870 s budget with a hard kill 10 s later,
 #   * DOTS_PASSED=<n> printed from the progress dots as a
 #     tamper-resistant pass count (parsed from the tee'd log, not from
@@ -26,6 +28,24 @@
 
 set -o pipefail
 cd "$(dirname "$0")/.."
+
+# Collection pre-gate: a broken import/collect error should fail the
+# gate in SECONDS-not-minutes with the offending module named, instead
+# of surfacing mid-run (or hiding behind
+# --continue-on-collection-errors in the main pass). --collect-only
+# runs no tests; the budget covers importing every test module on this
+# 1-core host (~90 s, jax import dominates).
+rm -f /tmp/_t1_collect.log
+if ! timeout -k 5 240 env JAX_PLATFORMS=cpu \
+    python -m pytest tests/ -q -m 'not slow' --collect-only \
+    -p no:cacheprovider > /tmp/_t1_collect.log 2>&1; then
+  echo "[tier1] COLLECTION FAILED — fix imports before the suite runs:"
+  tail -40 /tmp/_t1_collect.log
+  echo DOTS_PASSED=0
+  exit 2
+fi
+echo "[tier1] collection ok:" \
+  "$(grep -cE '::' /tmp/_t1_collect.log || true) tests collected"
 
 rm -f /tmp/_t1.log
 timeout -k 10 870 env JAX_PLATFORMS=cpu \
