@@ -696,6 +696,17 @@ def run_child_reducer(max_devices: int, platform: str = "cpu") -> None:
                        ring reduce-scatter over 'ici', one cross-slice
                        all-reduce on the 1/S shard over 'dcn', ring
                        all-gather back.
+
+    Plus the OVERLAPPED pair, which needs a backward to overlap with
+    (a small staged MLP, `models/staging.staged_model`):
+      * bwd_bucketed — jax.grad of the full model, THEN the bucketed
+                       reduction (every ring serialized behind the
+                       last backward dot);
+      * overlapped   — the stagewise backward
+                       (`staging.stagewise_value_and_grad`) firing each
+                       segment's buckets eagerly, late layers first —
+                       same math, rings data-dependent only on their
+                       own segment.
     Emits one partial JSON line per completed size (a wedge mid-sweep
     keeps the finished legs), then the table. Meaningful on a real
     slice; on virtual CPU devices the rings serialize onto one core
@@ -771,6 +782,65 @@ def run_child_reducer(max_devices: int, platform: str = "cpu") -> None:
             check_vma=False,
         ))
 
+    # ---- the overlapped pair's workload: a staged MLP whose backward
+    # the eager buckets can hide behind (module docstring).
+    from distributed_model_parallel_tpu.models import layers as L
+    from distributed_model_parallel_tpu.models import staging
+    from distributed_model_parallel_tpu.models.layers import Context
+
+    mlp_blocks = [
+        L.sequential(L.linear(256, 256), L.relu()) for _ in range(6)
+    ]
+    mlp = staging.staged_model(
+        L.sequential(L.linear(64, 256), L.relu()),
+        mlp_blocks,
+        L.linear(256, 10),
+    )
+    mlp_params, mlp_state = mlp.init(jax.random.PRNGKey(0))
+    mlp_cuts = staging.split_points(3, None, len(mlp_blocks))
+    mlp_bucket_mb = 0.1
+    ctx = Context(train=True)
+
+    def mlp_loss(y):
+        return 0.5 * jnp.sum(y * y)
+
+    def bwd_then_bucketed(params, x):
+        def loss(p):
+            y, _ = mlp.apply(p, mlp_state, x, ctx)
+            return mlp_loss(y)
+
+        g = jax.grad(loss)(params)
+        return bucketed_pmean(g, "data", bucket_mb=mlp_bucket_mb)
+
+    def overlapped_bwd(params, x):
+        fns = staging.stage_apply_fns(mlp.parts, mlp_cuts, ctx)
+        _, _, stage_grads, _ = staging.stagewise_value_and_grad(
+            fns,
+            lambda y: (mlp_loss(y), ()),
+            staging.partition_tree(params, mlp_cuts),
+            staging.partition_tree(mlp_state, mlp_cuts),
+            x,
+            on_stage_grads=lambda k, g: bucketed_pmean(
+                g, "data", bucket_mb=mlp_bucket_mb
+            ),
+        )
+        return staging.unpartition_tree(stage_grads, mlp_cuts)
+
+    def mlp_reducer(mesh, fn):
+        pspec = jax.tree_util.tree_map(lambda _: P(), mlp_params)
+        return jax.jit(shard_map(
+            fn, mesh=mesh, in_specs=(pspec, P("data")),
+            out_specs=pspec, check_vma=False,
+        ))
+
+    def time_mlp(fn, x, iters=10):
+        fence(fn(mlp_params, x))
+        t0 = time.perf_counter()
+        for _i in range(iters):
+            out = fn(mlp_params, x)
+        fence(out)
+        return (time.perf_counter() - t0) / iters * 1e3
+
     rows = []
     for size in sizes:
         flat_mesh = Mesh(np.array(devices[:size]), ("data",))
@@ -794,11 +864,18 @@ def run_child_reducer(max_devices: int, platform: str = "cpu") -> None:
             partial(bucketed_pmean, ici_axis="ici", dcn_axis="dcn",
                     bucket_mb=bucket_mb),
         )
+        bwd_bucketed = mlp_reducer(flat_mesh, bwd_then_bucketed)
+        overlapped = mlp_reducer(flat_mesh, overlapped_bwd)
+        # Weak-scaling batch (8 rows/device) so the 'data' shard is
+        # always whole and per-device backward work stays constant.
+        mlp_x = jnp.asarray(rng.randn(8 * size, 64), jnp.float32)
         row = {
             "axis_size": size,
             "naive_ms": round(time_fn(naive), 3),
             "bucketed_ms": round(time_fn(bucketed), 3),
             "hierarchical_ms": round(time_fn(hierarchical), 3),
+            "bwd_bucketed_ms": round(time_mlp(bwd_bucketed, mlp_x), 3),
+            "overlapped_ms": round(time_mlp(overlapped, mlp_x), 3),
         }
         row["bucketed_speedup"] = round(
             row["naive_ms"] / max(row["bucketed_ms"], 1e-9), 3
@@ -806,10 +883,15 @@ def run_child_reducer(max_devices: int, platform: str = "cpu") -> None:
         row["hierarchical_speedup"] = round(
             row["naive_ms"] / max(row["hierarchical_ms"], 1e-9), 3
         )
+        row["overlapped_speedup"] = round(
+            row["bwd_bucketed_ms"] / max(row["overlapped_ms"], 1e-9), 3
+        )
         rows.append(row)
         log(f"S={size}: naive {row['naive_ms']}ms, bucketed "
             f"{row['bucketed_ms']}ms, hierarchical "
-            f"{row['hierarchical_ms']}ms")
+            f"{row['hierarchical_ms']}ms, bwd+bucketed "
+            f"{row['bwd_bucketed_ms']}ms, overlapped "
+            f"{row['overlapped_ms']}ms")
         # Per-leg partial line (same convention as the other sweeps).
         print(json.dumps({"leg": row, "partial": True}), flush=True)
 
@@ -822,6 +904,11 @@ def run_child_reducer(max_devices: int, platform: str = "cpu") -> None:
         "bucket_mb": bucket_mb,
         "n_buckets": n_buckets,
         "hierarchy": "2 x S/2 (dcn x ici)",
+        "overlapped_workload": (
+            "staged MLP 64->256->10, 6 blocks, 3 backward segments, "
+            f"bucket_mb={mlp_bucket_mb} (bwd_bucketed = grad then "
+            "buckets; overlapped = stagewise eager firing)"
+        ),
     }
     if jax.devices()[0].platform == "cpu":
         out["note"] = (

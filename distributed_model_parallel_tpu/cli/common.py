@@ -327,14 +327,19 @@ def add_grad_reduction_flags(parser: argparse.ArgumentParser) -> None:
     CLIs (`ops/grad_reduction.py`)."""
     parser.add_argument(
         "--grad-reduction", default="monolithic",
-        choices=("monolithic", "bucketed"),
+        choices=("monolithic", "bucketed", "overlapped"),
         help="gradient reduction lowering: monolithic = one fused "
              "all-reduce of the whole grad pytree (the GSPMD default); "
              "bucketed = DDP-Reducer-style ~--bucket-mb flat buckets in "
              "reverse parameter order, each a chunked ppermute "
              "reduce-scatter/all-gather ring that interleaves with the "
              "remaining backward — hierarchical over a --dcn-slices "
-             "factored mesh (same math)",
+             "factored mesh (same math); overlapped = the bucketed "
+             "rings fired EAGERLY from a stagewise backward (the model "
+             "is cut into --overlap-stages segments, late layers "
+             "differentiate first and their buckets launch while "
+             "earlier segments are still running — the DDP Reducer's "
+             "autograd-hook overlap; same math)",
     )
     # None sentinel = "flag not passed": check_grad_reduction_args can
     # then reject an explicit --bucket-mb without bucketed mode (any
@@ -354,6 +359,17 @@ def add_grad_reduction_flags(parser: argparse.ArgumentParser) -> None:
              "and all-reduces only the 1/N shard across slices). On a "
              "single process this is a virtual split",
     )
+    # None sentinel, like --bucket-mb: reject the flag outside
+    # --grad-reduction overlapped, resolve the auto default (0 = the
+    # engine's min(4, n_blocks)) otherwise.
+    parser.add_argument(
+        "--overlap-stages", default=None, type=int,
+        help="backward segment count under --grad-reduction overlapped: "
+             "the model's blocks are cut into this many vjp segments "
+             "(pipeline-style split points) and each segment's buckets "
+             "fire as soon as its backward completes (default: "
+             "min(4, model blocks))",
+    )
 
 
 def check_grad_reduction_args(args) -> None:
@@ -365,17 +381,56 @@ def check_grad_reduction_args(args) -> None:
             raise SystemExit(
                 f"--bucket-mb must be > 0, got {args.bucket_mb}"
             )
-        if args.grad_reduction != "bucketed":
+        if args.grad_reduction not in ("bucketed", "overlapped"):
             raise SystemExit(
                 "--bucket-mb sizes the bucketed reducer's flat "
                 "buffers; it only applies under --grad-reduction "
-                "bucketed"
+                "bucketed / overlapped"
             )
     else:
         args.bucket_mb = 25.0
+    if args.overlap_stages is not None:
+        if args.grad_reduction != "overlapped":
+            raise SystemExit(
+                "--overlap-stages cuts the stagewise backward; it only "
+                "applies under --grad-reduction overlapped"
+            )
+        if args.overlap_stages < 2:
+            raise SystemExit(
+                "--overlap-stages must be >= 2 (one segment is the "
+                f"monolithic backward), got {args.overlap_stages}"
+            )
+    else:
+        args.overlap_stages = 0  # engine auto: min(4, model blocks)
     if args.dcn_slices < 1:
         raise SystemExit(
             f"--dcn-slices must be >= 1, got {args.dcn_slices}"
+        )
+
+
+def check_overlapped_model(name: str, overlap_stages: int = 0) -> None:
+    """Fail fast (before datasets/meshes are built) when
+    `--grad-reduction overlapped` is pointed at a model that cannot be
+    cut into >= 2 backward segments, or `--overlap-stages` asks for more
+    segments than the model has blocks — the stagewise engines would
+    raise the same complaints, but only after the data pipeline was paid
+    for. Builds the model STRUCTURE only (no init, no arrays)."""
+    if name not in MODELS:
+        return  # build_model raises the canonical unknown-model error
+    probe = MODELS[name](10)
+    parts = getattr(probe, "parts", None)
+    n_blocks = len(parts.blocks) if parts is not None else 0
+    if n_blocks < 2:
+        raise SystemExit(
+            "--grad-reduction overlapped splits the backward into >= 2 "
+            f"segments; --model {name} exposes {n_blocks} block(s) "
+            "(models/staging.staged_model anatomy)"
+        )
+    if overlap_stages > n_blocks:
+        raise SystemExit(
+            f"--overlap-stages {overlap_stages} exceeds the "
+            f"{n_blocks} blocks --model {name} exposes; each backward "
+            "segment needs at least one block"
         )
 
 

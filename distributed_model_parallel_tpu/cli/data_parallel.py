@@ -134,15 +134,21 @@ def main(argv=None) -> dict:
         if not os.path.exists(args.finetune):
             raise SystemExit(f"--finetune: no such file {args.finetune!r}")
     check_grad_reduction_args(args)
-    if args.grad_reduction == "bucketed" and args.engine not in (
+    if args.grad_reduction != "monolithic" and args.engine not in (
         "ddp", "fsdp"
     ):
         raise SystemExit(
-            "--grad-reduction bucketed replaces the explicit gradient "
-            "collective of the shard_map engines (ddp, fsdp); the "
-            f"declarative --engine {args.engine} step has no explicit "
-            "reduction site to bucket"
+            f"--grad-reduction {args.grad_reduction} replaces the "
+            "explicit gradient collective of the shard_map engines "
+            f"(ddp, fsdp); the declarative --engine {args.engine} step "
+            "has no explicit reduction site to bucket or overlap"
         )
+    if args.grad_reduction == "overlapped":
+        from distributed_model_parallel_tpu.cli.common import (
+            check_overlapped_model,
+        )
+
+        check_overlapped_model(args.model, args.overlap_stages)
     if args.engine == "tp" and args.dcn_slices != 1:
         raise SystemExit(
             "--dcn-slices factors the data axis for the hierarchical "
@@ -237,6 +243,7 @@ def main(argv=None) -> dict:
             input_transform=itf,
             grad_reduction=args.grad_reduction,
             bucket_mb=args.bucket_mb,
+            overlap_stages=args.overlap_stages,
         )
     elif args.engine == "fsdp":
         from distributed_model_parallel_tpu.parallel.fsdp import FSDPEngine
@@ -245,6 +252,7 @@ def main(argv=None) -> dict:
             model, opt, mesh, compute_dtype=cdt, input_transform=itf,
             grad_reduction=args.grad_reduction,
             bucket_mb=args.bucket_mb,
+            overlap_stages=args.overlap_stages,
         )
     elif args.engine == "tp":
         from distributed_model_parallel_tpu.parallel.tensor_parallel import (

@@ -176,11 +176,24 @@ def main(argv=None) -> dict:
         args.grad_reduction != "monolithic" or args.dcn_slices != 1
     ):
         raise SystemExit(
-            "--grad-reduction bucketed / --dcn-slices address the "
-            "sequence-parallel engine's data-axis gradient collective; "
-            "the pipeline engine reduces over 'stage' wires — drop the "
-            "flags or --pipeline-stages"
+            "--grad-reduction bucketed/overlapped / --dcn-slices "
+            "address the sequence-parallel engine's data-axis gradient "
+            "collective; the pipeline engine reduces over 'stage' "
+            "wires — drop the flags or --pipeline-stages"
         )
+    if args.grad_reduction == "overlapped":
+        if args.layers < 2:
+            raise SystemExit(
+                "--grad-reduction overlapped splits the decoder stack "
+                f"into >= 2 backward segments; --layers {args.layers} "
+                "leaves nothing to overlap"
+            )
+        if args.overlap_stages > args.layers:
+            raise SystemExit(
+                f"--overlap-stages {args.overlap_stages} exceeds "
+                f"--layers {args.layers}: a backward segment needs at "
+                "least one decoder block"
+            )
     if args.pipeline_stages > 1:
         check_pipeline_schedule_args(
             args.pipeline_schedule, args.virtual_stages,
@@ -244,6 +257,7 @@ def main(argv=None) -> dict:
             collective_matmul=args.collective_matmul,
             grad_reduction=args.grad_reduction,
             bucket_mb=args.bucket_mb,
+            overlap_stages=args.overlap_stages,
         )
     corpus = synthetic_corpus(
         args.vocab_size, args.corpus_tokens, seed=args.corpus_seed

@@ -206,11 +206,9 @@ def bert_for_classification(
     blocks = _encoder_blocks(cfg, attention_fn)
     if remat:
         blocks = [L.remat(b) for b in blocks]
-    return L.named([
-        ("stem", _embeddings(cfg)),
-        ("blocks", L.sequential(*blocks)),
-        ("head", _cls_head(cfg, num_classes)),
-    ])
+    return staging.staged_model(
+        _embeddings(cfg), blocks, _cls_head(cfg, num_classes)
+    )
 
 
 def bert_base(num_classes: int = 2) -> L.Layer:

@@ -146,14 +146,12 @@ def gpt_lm(
     positions (see tests/test_gpt.py for the working recipe; a fully
     seq-sharded stem needs the SequenceParallelEngine position-offset
     treatment)."""
+    from distributed_model_parallel_tpu.models import staging
+
     blocks = decoder_blocks(cfg, attention_fn)
     if remat:
         blocks = [L.remat(b) for b in blocks]
-    return L.named([
-        ("stem", _lm_stem(cfg)),
-        ("blocks", L.sequential(*blocks)),
-        ("head", _lm_head(cfg)),
-    ])
+    return staging.staged_model(_lm_stem(cfg), blocks, _lm_head(cfg))
 
 
 def _lm_head_flat(cfg: GPTConfig) -> L.Layer:

@@ -75,6 +75,13 @@ class Context:
 class Layer:
     init: Callable[[jax.Array], tuple[Params, State]]
     apply: Callable[[Params, State, jax.Array, Context], tuple[jax.Array, State]]
+    # Optional stage anatomy (`models/staging.StageParts`) attached by
+    # `staging.staged_model` on the stem/blocks/head families: the
+    # component Layers the composed model was assembled from, which the
+    # stagewise-backward engines (`grad_reduction="overlapped"`) cut
+    # into per-stage vjp segments. None for models without the
+    # convention; composition/apply never reads it.
+    parts: Optional[Any] = None
 
 
 def _uniform(key, shape, bound, dtype=jnp.float32):

@@ -109,11 +109,9 @@ def mobilenet_v2(num_classes: int = 10, *, batchnorm: bool = True,
     blocks = _make_blocks(batchnorm=batchnorm)
     if remat:
         blocks = [L.remat(b) for b in blocks]
-    return L.named([
-        ("stem", _stem(batchnorm)),
-        ("blocks", L.sequential(*blocks)),
-        ("head", _head(num_classes, batchnorm)),
-    ])
+    return staging.staged_model(
+        _stem(batchnorm), blocks, _head(num_classes, batchnorm)
+    )
 
 
 def mobilenet_v2_nobn(num_classes: int = 10, *, remat: bool = False) -> L.Layer:

@@ -119,11 +119,9 @@ def resnet(depth: int, num_classes: int = 1000, *, cifar: bool = False,
     blocks, feat = _make_blocks(depth)
     if remat:
         blocks = [L.remat(b) for b in blocks]
-    return L.named([
-        ("stem", _stem(cifar)),
-        ("blocks", L.sequential(*blocks)),
-        ("head", _head(feat, num_classes)),
-    ])
+    return staging.staged_model(
+        _stem(cifar), blocks, _head(feat, num_classes)
+    )
 
 
 def resnet18(num_classes: int = 10, *, cifar: bool = True,

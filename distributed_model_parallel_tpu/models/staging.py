@@ -11,9 +11,11 @@ into the matching pipeline run and vice versa.
 
 from __future__ import annotations
 
-from typing import Any, List, Sequence, Tuple
+import dataclasses
+from typing import Any, Callable, List, Optional, Sequence, Tuple
 
 import jax
+import jax.numpy as jnp
 
 from distributed_model_parallel_tpu.models import layers as L
 
@@ -129,3 +131,194 @@ def partition_tree(tree: Any, cuts: Sequence[int]) -> List[dict]:
             parts.append(tree["head"])
         out.append({str(j): p for j, p in enumerate(parts)})
     return out
+
+
+def unpartition_tree(stage_trees: Sequence[dict],
+                     cuts: Sequence[int]) -> dict:
+    """Inverse of `partition_tree`: reassemble per-stage sequential-keyed
+    trees into the full-model `{stem, blocks:{'0'..}, head}` layout, so a
+    stagewise backward hands the optimizer a gradient pytree
+    indistinguishable from the monolithic `jax.grad`'s."""
+    num_stages = len(cuts) - 1
+    out: dict = {"blocks": {}}
+    for i, stage in enumerate(stage_trees):
+        k = 0
+        if i == 0:
+            out["stem"] = stage[str(k)]
+            k += 1
+        for b in range(cuts[i], cuts[i + 1]):
+            out["blocks"][str(b)] = stage[str(k)]
+            k += 1
+        if i == num_stages - 1:
+            out["head"] = stage[str(k)]
+    return out
+
+
+# ------------------------------------------------- stagewise backward
+# The overlapped-reducer substrate (`grad_reduction="overlapped"` on the
+# DDP/FSDP/CausalLM-SP engines): instead of one `jax.grad` over the whole
+# model — whose gradient pytree exists only after the LAST backward op —
+# the forward is cut at the same block boundaries the pipeline engines
+# use (`split_points`), one `jax.vjp` closure saved per segment, and the
+# closures are called in REVERSE (late layers first). Stage k's parameter
+# gradients are therefore complete — and can be handed to the bucketed
+# ring reduction (`ops/grad_reduction.py`) — while stage k-1's backward
+# has not produced a single op that the reduction depends on, which is
+# exactly the data-dependence structure the DDP Reducer's autograd hooks
+# buy (Li et al., VLDB 2020; PAPERS.md).
+
+
+@dataclasses.dataclass(frozen=True)
+class StageParts:
+    """The stem/blocks/head anatomy of a composed model, attached to the
+    built `Layer` by `staged_model` so engines can re-cut the SAME layer
+    objects (same params layout, same `Context.child` rng folding) into
+    backward segments without re-building the model."""
+
+    stem: L.Layer
+    blocks: Tuple[L.Layer, ...]
+    head: L.Layer
+
+
+def staged_model(stem: L.Layer, blocks: Sequence[L.Layer],
+                 head: L.Layer) -> L.Layer:
+    """Compose the canonical `named([stem, blocks, head])` model AND
+    attach its `StageParts` — the one constructor the model zoo's
+    stem/blocks/head families share, so every one of them is eligible
+    for the stagewise-backward engines."""
+    model = L.named([
+        ("stem", stem),
+        ("blocks", L.sequential(*blocks)),
+        ("head", head),
+    ])
+    return dataclasses.replace(
+        model, parts=StageParts(stem, tuple(blocks), head)
+    )
+
+
+def resolve_overlap_segments(n_blocks: int, overlap_stages: int,
+                             label: str, noun: str = "blocks") -> int:
+    """Validate-and-default the stagewise segment count shared by every
+    overlapped engine: 0 = auto (min(4, n_blocks)); otherwise the count
+    must give >= 2 segments and <= one block per segment. Raises with
+    engine vocabulary (`label` names the knob's surface, `noun` the
+    unit being cut)."""
+    if n_blocks < 2:
+        raise ValueError(
+            f"{label}: grad_reduction='overlapped' splits the backward "
+            f"into >= 2 segments; the model has only {n_blocks} "
+            f"{noun[:-1]}(s)"
+        )
+    if overlap_stages == 0:
+        return min(4, n_blocks)
+    if overlap_stages < 2 or overlap_stages > n_blocks:
+        raise ValueError(
+            f"{label}: overlap_stages must be in [2, {n_blocks}] "
+            f"({noun}), got {overlap_stages}"
+        )
+    return overlap_stages
+
+
+def resolve_overlap_stages(parts: Optional[StageParts],
+                           overlap_stages: int, label: str) -> int:
+    """`resolve_overlap_segments` over a `StageParts` anatomy (the
+    stem/blocks/head engines' entry point; raises when the model never
+    went through `staged_model`)."""
+    if parts is None:
+        raise ValueError(
+            f"{label}: grad_reduction='overlapped' needs a model that "
+            "exposes its stem/blocks/head anatomy "
+            "(models/staging.staged_model); this model has no .parts"
+        )
+    return resolve_overlap_segments(
+        len(parts.blocks), overlap_stages, label
+    )
+
+
+def stage_apply_fns(parts: StageParts, cuts: Sequence[int],
+                    ctx: L.Context) -> List[Callable]:
+    """Per-stage apply closures over `partition_tree`-layout stage trees.
+
+    Each closure `fn(stage_params, stage_state, x) -> (y, new_state)`
+    applies its slice of the model with the SAME `Context.child` chain
+    the composed `staged_model` layer uses (stem -> ctx.child(0), block
+    j -> ctx.child(1).child(j), head -> ctx.child(2)), so the stagewise
+    forward/backward is bit-identical to the monolithic one — including
+    dropout masks, which fold the global child indices into the rng."""
+    num_stages = len(cuts) - 1
+    block_ctx = ctx.child(1)
+    fns = []
+    for i in range(num_stages):
+        entries = []
+        if i == 0:
+            entries.append((parts.stem, ctx.child(0)))
+        for j in range(cuts[i], cuts[i + 1]):
+            entries.append((parts.blocks[j], block_ctx.child(j)))
+        if i == num_stages - 1:
+            entries.append((parts.head, ctx.child(2)))
+
+        def fn(params, state, x, entries=entries):
+            new_state = {}
+            for k, (layer, c) in enumerate(entries):
+                x, s = layer.apply(params[str(k)], state[str(k)], x, c)
+                new_state[str(k)] = s
+            return x, new_state
+
+        fns.append(fn)
+    return fns
+
+
+def stagewise_value_and_grad(
+    stage_fns: Sequence[Callable],
+    loss_fn: Callable,
+    stage_params: Sequence[Any],
+    stage_states: Sequence[Any],
+    x: Any,
+    *,
+    aux_of_state: Optional[Callable] = None,
+    on_stage_grads: Optional[Callable] = None,
+):
+    """Segment-by-segment value-and-grad: chain per-stage `jax.vjp`
+    closures in reverse, late layers first.
+
+    `stage_fns[k](params_k, state_k, x) -> (y, new_state_k)`;
+    `loss_fn(y_last) -> (loss, loss_aux)` (the scalar is differentiated).
+    Differentiable side-penalties riding the state (`moe_aux`) enter
+    through `aux_of_state(new_state_k) -> scalar`, whose unit cotangent
+    adds each stage's d(aux)/d(params) exactly as a monolithic
+    `loss + sum(aux)` grad would.
+
+    `on_stage_grads(k, grads_k)` is the Reducer hook: it runs as soon as
+    stage k's backward closure returns, BEFORE stage k-1's backward is
+    traced, so whatever collectives it issues are data-dependent only on
+    stages >= k. Returns (loss, loss_aux, stage_grads, stage_new_states)
+    — grads in `partition_tree` stage layout (reassemble with
+    `unpartition_tree`); equals the monolithic `jax.grad` bit for bit
+    (tests/test_grad_reduction.py)."""
+    n = len(stage_fns)
+    vjps, auxes, new_states = [], [], []
+    y = x
+    for k in range(n):
+        def fwd(p, xx, k=k):
+            out, ns = stage_fns[k](p, stage_states[k], xx)
+            a = aux_of_state(ns) if aux_of_state is not None else None
+            return (out, a), ns
+
+        with jax.named_scope(f"fwd_stage{k}"):
+            (y, a), vjp_fn, ns = jax.vjp(
+                fwd, stage_params[k], y, has_aux=True
+            )
+        vjps.append(vjp_fn)
+        auxes.append(a)
+        new_states.append(ns)
+    with jax.named_scope("loss_head"):
+        loss, loss_vjp, loss_aux = jax.vjp(loss_fn, y, has_aux=True)
+        cot = loss_vjp(jnp.ones_like(loss))[0]
+    grads: List[Any] = [None] * n
+    for k in reversed(range(n)):
+        with jax.named_scope(f"bwd_stage{k}"):
+            a_bar = None if auxes[k] is None else jnp.ones_like(auxes[k])
+            dp, dx = vjps[k]((cot, a_bar))
+        grads[k] = dp if on_stage_grads is None else on_stage_grads(k, dp)
+        cot = dx
+    return loss, loss_aux, grads, new_states

@@ -45,11 +45,7 @@ def tiny_cnn(num_classes: int = 10, *, remat: bool = False) -> L.Layer:
     blocks = [_block(i) for i in range(N_BLOCKS)]
     if remat:
         blocks = [L.remat(b) for b in blocks]
-    return L.named([
-        ("stem", _stem()),
-        ("blocks", L.sequential(*blocks)),
-        ("head", _head(num_classes)),
-    ])
+    return staging.staged_model(_stem(), blocks, _head(num_classes))
 
 
 def split_stages(num_stages: int, num_classes: int = 10, *,

@@ -200,11 +200,11 @@ def vit(
     ]
     if remat:
         blocks = [L.remat(b) for b in blocks]
-    return L.named([
-        ("stem", _vit_stem(cfg)),
-        ("blocks", L.sequential(*blocks)),
-        ("head", _vit_head(cfg, num_classes)),
-    ])
+    from distributed_model_parallel_tpu.models import staging
+
+    return staging.staged_model(
+        _vit_stem(cfg), blocks, _vit_head(cfg, num_classes)
+    )
 
 
 def vit_b16(num_classes: int = 1000, **kw) -> L.Layer:

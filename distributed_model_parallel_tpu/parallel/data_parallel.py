@@ -28,7 +28,12 @@ Reducer-faithful path (`ops/grad_reduction.py`): ~`bucket_mb` flat
 buckets in reverse registration order, each reduced as chunked ppermute
 rings — hierarchically (reduce-scatter over 'ici', cross-slice
 all-reduce over 'dcn' on the 1/N shard, all-gather back) when the mesh
-is a hybrid `MeshSpec(dcn=K)` one.
+is a hybrid `MeshSpec(dcn=K)` one. `grad_reduction="overlapped"` fires
+those same buckets EAGERLY from a stagewise backward
+(`models/staging.stagewise_value_and_grad`, INTERNALS §3f): per-segment
+vjp closures run late-layers-first and hand each completed segment's
+grads to the rings before the earlier segments' backward exists — the
+Reducer's autograd-hook overlap, expressed as data dependence.
 
 Both engines run on either mesh family: the data-parallel world is
 `data_axis_names(mesh)` — ('data',) on a plain mesh, ('dcn', 'ici') on
@@ -50,6 +55,7 @@ from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from distributed_model_parallel_tpu.runtime.compat import shard_map
 
+from distributed_model_parallel_tpu.models import staging
 from distributed_model_parallel_tpu.models.layers import Context, Layer
 from distributed_model_parallel_tpu.ops.grad_reduction import (
     bucketed_pmean,
@@ -273,16 +279,40 @@ class DDPEngine:
     # registration order, each a chunked-ppermute ring reduce-scatter/
     # all-gather over the intra-slice fabric with a single cross-slice
     # all-reduce on the 1/N shard when the mesh carries a 'dcn' factor.
-    # Same math (parity pinned at rtol 1e-5, tests/test_grad_reduction).
+    # "overlapped": the bucketed path FIRED EAGERLY from a stagewise
+    # backward (`models/staging.stagewise_value_and_grad`): the model is
+    # cut at `overlap_stages` block boundaries, per-stage vjp closures
+    # run in reverse, and stage k's bucket rings are handed off before
+    # stage k-1's backward exists — so the reduction is data-dependent
+    # only on stages >= k and XLA can schedule it beside the remaining
+    # backward dots (the Reducer's autograd-hook overlap, Li VLDB'20).
+    # Same math in all three (parity at rtol 1e-5,
+    # tests/test_grad_reduction.py; dependency pins in
+    # tests/test_collectives_hlo.py).
     grad_reduction: str = "monolithic"
     bucket_mb: float = 25.0
+    # Backward segment count under "overlapped" (0 = auto: min(4, number
+    # of model blocks)); cuts reuse the pipeline engines' block
+    # partitioning (`models/staging.split_points`).
+    overlap_stages: int = 0
 
     def __post_init__(self):
-        if self.grad_reduction not in ("monolithic", "bucketed"):
+        if self.grad_reduction not in (
+            "monolithic", "bucketed", "overlapped"
+        ):
             raise ValueError(
-                "grad_reduction must be 'monolithic' or 'bucketed', "
-                f"got {self.grad_reduction!r}"
+                "grad_reduction must be 'monolithic', 'bucketed' or "
+                f"'overlapped', got {self.grad_reduction!r}"
             )
+        overlapped = self.grad_reduction == "overlapped"
+        if overlapped:
+            n_stages = staging.resolve_overlap_stages(
+                self.model.parts, self.overlap_stages, "DDPEngine"
+            )
+            cuts = staging.split_points(
+                n_stages, None, len(self.model.parts.blocks)
+            )
+            parts = self.model.parts
         mesh = self.mesh
         d_axes, ici_axis, dcn_axis = data_hierarchy_axes(mesh)
         self._repl = NamedSharding(mesh, P())
@@ -313,29 +343,64 @@ class DDPEngine:
             images_c = _cast_input(
                 _apply_input_transform(tf, images, ts.step, True), cdt
             )
+            ctx = Context(
+                train=True, bn_axis=bn_axis, rng=rng, dtype=cdt
+            )
 
-            def loss_fn(params, model_state):
-                logits, new_state = model.apply(
-                    params, model_state, images_c,
-                    Context(train=True, bn_axis=bn_axis, rng=rng, dtype=cdt),
-                )
-                ce = cross_entropy(logits, labels)
-                return ce + aux_loss(new_state), (new_state, logits, ce)
+            if overlapped:
+                # Stagewise backward with eager bucket firing: stage
+                # k's grads ride their rings while stage k-1 is still
+                # differentiating (class docstring; the Reducer's
+                # autograd-hook overlap as explicit data dependence).
+                def reduce_stage(k, stage_grads):
+                    with jax.named_scope(f"grad_reduce_stage{k}"):
+                        return bucketed_pmean(
+                            stage_grads, ici_axis, dcn_axis,
+                            bucket_mb=bucket_mb,
+                        )
 
-            (_, (new_state, logits, ce)), grads = jax.value_and_grad(
-                loss_fn, has_aux=True
-            )(ts.params, ts.model_state)
-            loss = ce
-            if bucketed:
-                # The Reducer path: per-bucket rings, hierarchical over
-                # a dcn×ici mesh (`ops/grad_reduction.py` docstring).
-                grads = bucketed_pmean(
-                    grads, ici_axis, dcn_axis, bucket_mb=bucket_mb
+                def loss_head(logits):
+                    ce = cross_entropy(logits, labels)
+                    return ce, (logits, ce)
+
+                _, (logits, ce), stage_grads, stage_states = (
+                    staging.stagewise_value_and_grad(
+                        staging.stage_apply_fns(parts, cuts, ctx),
+                        loss_head,
+                        staging.partition_tree(ts.params, cuts),
+                        staging.partition_tree(ts.model_state, cuts),
+                        images_c,
+                        aux_of_state=aux_loss,
+                        on_stage_grads=reduce_stage,
+                    )
                 )
+                grads = staging.unpartition_tree(stage_grads, cuts)
+                new_state = staging.unpartition_tree(stage_states, cuts)
             else:
-                # THE all-reduce: mean-over-global-batch gradient in one
-                # fused collective (replaces Reducer buckets + NCCL ring).
-                grads = lax.pmean(grads, d_axes)
+                def loss_fn(params, model_state):
+                    logits, new_state = model.apply(
+                        params, model_state, images_c, ctx
+                    )
+                    ce = cross_entropy(logits, labels)
+                    return ce + aux_loss(new_state), (
+                        new_state, logits, ce
+                    )
+
+                (_, (new_state, logits, ce)), grads = jax.value_and_grad(
+                    loss_fn, has_aux=True
+                )(ts.params, ts.model_state)
+                if bucketed:
+                    # The Reducer path: per-bucket rings, hierarchical
+                    # over a dcn×ici mesh (`ops/grad_reduction.py`).
+                    grads = bucketed_pmean(
+                        grads, ici_axis, dcn_axis, bucket_mb=bucket_mb
+                    )
+                else:
+                    # THE all-reduce: mean-over-global-batch gradient in
+                    # one fused collective (replaces Reducer buckets +
+                    # NCCL ring).
+                    grads = lax.pmean(grads, d_axes)
+            loss = ce
             if not self.sync_bn:
                 # Deterministic persisted stats (see class docstring).
                 new_state = lax.pmean(new_state, d_axes)

@@ -33,6 +33,15 @@ is unchanged. BatchNorm runs in SyncBN mode (global batch statistics),
 matching the declarative engine's semantics; parity at rtol 1e-5 is
 pinned in tests/test_grad_reduction.py.
 
+`grad_reduction="overlapped"` drives the same explicit collectives
+from a STAGEWISE loop (INTERNALS §3f; Rajbhandari et al., ZeRO —
+PAPERS.md): per-segment forward on freshly gathered weights, reverse
+backward re-linearizing each segment on a REGATHERED copy (prefetched
+one segment ahead, dependent only on the parameter shards) and firing
+each segment's bucket rings eagerly. Costs: gather traffic doubles and
+each segment's forward recomputes in the backward — the standard
+ZeRO-3 + activation-checkpointing trade; at-rest memory stays 1/N.
+
 Compose with the other axes by SUBCLASSING and overriding
 `param_specs` (e.g. rule-matched leaves keep their 'model'/'expert'
 spec, everything else falls to the FSDP shape policy); the `rules`
@@ -52,6 +61,7 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import NamedSharding, PartitionSpec as P
 
+from distributed_model_parallel_tpu.models import staging
 from distributed_model_parallel_tpu.models.layers import Context
 from distributed_model_parallel_tpu.ops.grad_reduction import (
     bucketed_pmean,
@@ -130,8 +140,24 @@ class FSDPEngine(TensorParallelEngine):
     # "monolithic": declarative jit step, partitioner-inserted
     # gather/scatter (default). "bucketed": explicit shard_map step with
     # Reducer-style hierarchical flat-bucket gradient reduction.
+    # "overlapped": the bucketed step driven by a STAGEWISE backward
+    # with both ZeRO overlaps (Rajbhandari et al., SC 2020; PAPERS.md):
+    # the forward runs segment-by-segment on freshly gathered stage
+    # weights; the backward loop walks the segments in reverse,
+    # re-gathering each stage's weights at backward time (the ZeRO-3
+    # "free after forward, regather in backward" discipline, expressed
+    # as stage-boundary rematerialization) with stage k-1's all-gather
+    # ISSUED one segment ahead — data-dependent only on the parameter
+    # shards, never on stage k's in-flight bucket rings — and fires
+    # each completed stage's bucketed reduce-scatter/all-gather rings
+    # eagerly, then slices this device's 1/N shard. Dependency pins in
+    # tests/test_collectives_hlo.py; parity at rtol 1e-5 in
+    # tests/test_grad_reduction.py.
     grad_reduction: str = "monolithic"
     bucket_mb: float = 25.0
+    # Backward segment count under "overlapped" (0 = auto: min(4, number
+    # of model blocks)).
+    overlap_stages: int = 0
 
     def __post_init__(self):
         if self.rules:
@@ -141,12 +167,14 @@ class FSDPEngine(TensorParallelEngine):
                 "and override param_specs to compose FSDP with "
                 "'model'/'expert' rule sharding."
             )
-        if self.grad_reduction not in ("monolithic", "bucketed"):
+        if self.grad_reduction not in (
+            "monolithic", "bucketed", "overlapped"
+        ):
             raise ValueError(
-                "grad_reduction must be 'monolithic' or 'bucketed', "
-                f"got {self.grad_reduction!r}"
+                "grad_reduction must be 'monolithic', 'bucketed' or "
+                f"'overlapped', got {self.grad_reduction!r}"
             )
-        if self.grad_reduction == "bucketed":
+        if self.grad_reduction in ("bucketed", "overlapped"):
             if self.collective_matmul:
                 # The explicit step below never threads a matmul policy
                 # through Context — silently dropping the flag would
@@ -154,10 +182,10 @@ class FSDPEngine(TensorParallelEngine):
                 # path at least fails on its missing 'model' axis).
                 raise ValueError(
                     "collective_matmul=True is not supported by the "
-                    "bucketed FSDP step (no matmul policy is threaded "
-                    "through the explicit shard_map program)"
+                    f"{self.grad_reduction} FSDP step (no matmul policy "
+                    "is threaded through the explicit shard_map program)"
                 )
-            self._build_bucketed()
+            self._build_explicit(self.grad_reduction == "overlapped")
         else:
             super().__post_init__()
 
@@ -170,11 +198,15 @@ class FSDPEngine(TensorParallelEngine):
 
     # ------------------------------------- explicit bucketed-RS step
 
-    def _build_bucketed(self):
+    def _build_explicit(self, overlapped: bool):
         """The shard_map twin of the declarative step: same state
         layout (`_state_sh`), explicit collectives — per-leaf weight
         all-gather on entry, bucketed hierarchical gradient reduction,
-        local 1/N slice, sharded optimizer update."""
+        local 1/N slice, sharded optimizer update. With
+        `overlapped=True` the same collectives fire from a STAGEWISE
+        loop instead (class docstring): per-stage forward on freshly
+        gathered weights, reverse backward with prefetched regather +
+        eager per-stage bucket reduction."""
         mesh = self.mesh
         d_axes, ici_axis, dcn_axis = data_hierarchy_axes(mesh)
         n_data = data_axis_size(mesh)
@@ -207,7 +239,7 @@ class FSDPEngine(TensorParallelEngine):
             P(),
         )
 
-        def gather_params(params):
+        def gather_tree(tree, specs):
             """Per-leaf weight all-gather: the ZeRO-3 'materialize right
             before use' collective, explicit."""
 
@@ -217,9 +249,9 @@ class FSDPEngine(TensorParallelEngine):
                     return leaf
                 return lax.all_gather(leaf, axes, axis=d, tiled=True)
 
-            return jax.tree_util.tree_map(gather, params, pspecs)
+            return jax.tree_util.tree_map(gather, tree, specs)
 
-        def shard_grads(grads):
+        def slice_tree(grads, specs):
             """Slice this device's 1/N of each fully-reduced leaf —
             local, no collective (the bucket rings already placed the
             reduced bytes everywhere)."""
@@ -234,7 +266,20 @@ class FSDPEngine(TensorParallelEngine):
                     leaf, idx * block, block, axis=d
                 )
 
-            return jax.tree_util.tree_map(slice_leaf, grads, pspecs)
+            return jax.tree_util.tree_map(slice_leaf, grads, specs)
+
+        def gather_params(params):
+            return gather_tree(params, pspecs)
+
+        if overlapped:
+            n_stages = staging.resolve_overlap_stages(
+                model.parts, self.overlap_stages, "FSDPEngine"
+            )
+            cuts = staging.split_points(
+                n_stages, None, len(model.parts.blocks)
+            )
+            parts = model.parts
+            stage_specs = staging.partition_tree(pspecs, cuts)
 
         def shard_step(ts: TrainState, images, labels, lr):
             rng = jax.random.fold_in(
@@ -264,7 +309,7 @@ class FSDPEngine(TensorParallelEngine):
                 grads, ici_axis, dcn_axis, bucket_mb=bucket_mb
             )
             params, opt_state = self.optimizer.update(
-                ts.params, ts.opt_state, shard_grads(grads), lr
+                ts.params, ts.opt_state, slice_tree(grads, pspecs), lr
             )
             new_ts = TrainState(params, new_state, opt_state, ts.step + 1)
             m = _metrics(ce, logits, labels)
@@ -272,6 +317,97 @@ class FSDPEngine(TensorParallelEngine):
                 lambda v: lax.psum(v, d_axes), m
             )
             return new_ts, m
+
+        def overlapped_step(ts: TrainState, images, labels, lr):
+            """Both ZeRO overlaps, stagewise (class docstring):
+
+            forward   k = 0..S-1 : gather stage k -> apply -> drop
+            backward  k = S-1..0 : PREFETCH gather of stage k-1 (depends
+                                   only on the parameter shards), re-vjp
+                                   stage k on its regathered weights
+                                   (stage-boundary remat), fire stage
+                                   k's bucket rings, slice own 1/N."""
+            rng = jax.random.fold_in(
+                jax.random.fold_in(jax.random.PRNGKey(0), ts.step),
+                data_replica_index(d_axes),
+            )
+            images_c = _cast_input(
+                _apply_input_transform(tf, images, ts.step, True), cdt
+            )
+            ctx = Context(train=True, bn_axis=d_axes, rng=rng, dtype=cdt)
+            fns = staging.stage_apply_fns(parts, cuts, ctx)
+            stage_sharded = staging.partition_tree(ts.params, cuts)
+            stage_states = staging.partition_tree(ts.model_state, cuts)
+
+            # ---- forward: per-stage gather, keep only the boundary
+            # activations and the new BN state.
+            xs, new_states = [], []
+            y = images_c
+            for k in range(n_stages):
+                with jax.named_scope(f"fwd_gather_stage{k}"):
+                    full_k = gather_tree(stage_sharded[k], stage_specs[k])
+                xs.append(y)
+                with jax.named_scope(f"fwd_stage{k}"):
+                    y, ns = fns[k](full_k, stage_states[k], y)
+                new_states.append(ns)
+            with jax.named_scope("loss_head"):
+                def loss_head(logits):
+                    ce = cross_entropy(logits, labels)
+                    return ce, (logits, ce)
+
+                loss, loss_vjp, (logits, ce) = jax.vjp(
+                    loss_head, y, has_aux=True
+                )
+                cot = loss_vjp(jnp.ones_like(loss))[0]
+
+            # ---- backward: reverse stagewise loop with one-ahead
+            # gather prefetch. The optimization_barrier keeps the
+            # regather a DISTINCT op from the forward gather (CSE would
+            # otherwise fold them and pin the weights live through the
+            # whole backward).
+            def regather(k):
+                shards = lax.optimization_barrier(stage_sharded[k])
+                return gather_tree(shards, stage_specs[k])
+
+            with jax.named_scope(f"prefetch_gather_stage{n_stages - 1}"):
+                prefetched = regather(n_stages - 1)
+            stage_grads = [None] * n_stages
+            for k in reversed(range(n_stages)):
+                full_k = prefetched
+                if k > 0:
+                    with jax.named_scope(f"prefetch_gather_stage{k - 1}"):
+                        prefetched = regather(k - 1)
+
+                def fwd(p, xx, k=k):
+                    out, ns = fns[k](p, stage_states[k], xx)
+                    return (out, aux_loss(ns)), ns
+
+                with jax.named_scope(f"bwd_stage{k}"):
+                    (_, a), vjp_fn, _ = jax.vjp(
+                        fwd, full_k, xs[k], has_aux=True
+                    )
+                    dp, dx = vjp_fn((cot, jnp.ones_like(a)))
+                with jax.named_scope(f"grad_reduce_stage{k}"):
+                    dp = bucketed_pmean(
+                        dp, ici_axis, dcn_axis, bucket_mb=bucket_mb
+                    )
+                    stage_grads[k] = slice_tree(dp, stage_specs[k])
+                cot = dx
+
+            grads = staging.unpartition_tree(stage_grads, cuts)
+            new_state = staging.unpartition_tree(new_states, cuts)
+            params, opt_state = self.optimizer.update(
+                ts.params, ts.opt_state, grads, lr
+            )
+            new_ts = TrainState(params, new_state, opt_state, ts.step + 1)
+            m = _metrics(ce, logits, labels)
+            m = jax.tree_util.tree_map(
+                lambda v: lax.psum(v, d_axes), m
+            )
+            return new_ts, m
+
+        if overlapped:
+            shard_step = overlapped_step
 
         def shard_eval(ts: TrainState, images, labels):
             images_c = _cast_input(

@@ -340,9 +340,18 @@ class CausalLMSequenceParallelEngine:
     # "bucketed": Reducer-style flat buckets over the data fabric(s) —
     # ring reduce-scatter over 'ici', cross-slice all-reduce over 'dcn',
     # ring all-gather (`ops/grad_reduction.py`); hierarchy-aware on a
-    # `MeshSpec(dcn=K)` mesh.
+    # `MeshSpec(dcn=K)` mesh. "overlapped": the bucketed path fired
+    # EAGERLY from a stagewise backward — decoder blocks are cut into
+    # `overlap_stages` segments (`models/staging.split_points`), per-
+    # segment vjp closures run late-layers-first, and each completed
+    # segment's 'seq' psum + data-bucket rings launch before the earlier
+    # segments' backward exists (tests/test_collectives_hlo.py pins the
+    # dependency structure; parity in tests/test_grad_reduction.py).
     grad_reduction: str = "monolithic"
     bucket_mb: float = 25.0
+    # Backward segment count under "overlapped" (0 = auto: min(4,
+    # cfg.num_layers)).
+    overlap_stages: int = 0
 
     def __post_init__(self):
         from distributed_model_parallel_tpu.models.gpt import (
@@ -361,15 +370,35 @@ class CausalLMSequenceParallelEngine:
                 f"attention must be one of {sorted(ATTENTION)}, "
                 f"got {self.attention!r}"
             )
-        if self.grad_reduction not in ("monolithic", "bucketed"):
+        if self.grad_reduction not in (
+            "monolithic", "bucketed", "overlapped"
+        ):
             raise ValueError(
-                "grad_reduction must be 'monolithic' or 'bucketed', "
-                f"got {self.grad_reduction!r}"
+                "grad_reduction must be 'monolithic', 'bucketed' or "
+                f"'overlapped', got {self.grad_reduction!r}"
             )
         d_axes, ici_axis, dcn_axis = data_hierarchy_axes(mesh)
         bucketed = self.grad_reduction == "bucketed"
+        overlapped = self.grad_reduction == "overlapped"
         bucket_mb = self.bucket_mb
         cfg = self.cfg
+        if overlapped:
+            if cfg.num_layers < 2:
+                raise ValueError(
+                    "CausalLMSequenceParallelEngine: grad_reduction="
+                    "'overlapped' splits the decoder stack into >= 2 "
+                    f"backward segments; cfg.num_layers={cfg.num_layers}"
+                )
+            from distributed_model_parallel_tpu.models.staging import (
+                resolve_overlap_segments,
+                split_points,
+            )
+
+            n_over = resolve_overlap_segments(
+                cfg.num_layers, self.overlap_stages,
+                "CausalLMSequenceParallelEngine", noun="decoder blocks",
+            )
+            over_cuts = split_points(n_over, None, cfg.num_layers)
         self._lm_targets = partial(
             lm_targets, pad_token_id=cfg.pad_token_id
         )
@@ -423,6 +452,45 @@ class CausalLMSequenceParallelEngine:
                 cross_entropy(flat_logits, flat_t), flat_logits, flat_t
             )
 
+        def overlap_stage_fns(ctx):
+            """Per-segment closures for the stagewise backward: the SAME
+            stem/blocks/head math as `forward` (identical Context.child
+            folding: stem -> ctx.child(0), block j -> ctx.child(1)
+            .child(j)), cut at `over_cuts` block boundaries. Stage 0
+            takes the local ids; the (hidden, mask) pair rides between
+            segments; the LM head closes the last one."""
+            block_ctx = ctx.child(1)
+            fns = []
+            n_over = len(over_cuts) - 1
+            for i in range(n_over):
+                def fn(p, _state, x, i=i):
+                    k = 0
+                    if i == 0:
+                        tl = x.shape[1]
+                        s_idx = lax.axis_index("seq")
+                        pos = lax.dynamic_slice_in_dim(
+                            p["0"]["position"], s_idx * tl, tl, axis=0
+                        )
+                        y = lm_stem_apply(
+                            p["0"], x, cfg, drop, ctx.child(0),
+                            positions=pos,
+                        )
+                        k = 1
+                    else:
+                        y = x
+                    for j in range(over_cuts[i], over_cuts[i + 1]):
+                        y, _ = block_list[j].apply(
+                            p[str(k)], {}, y, block_ctx.child(j)
+                        )
+                        k += 1
+                    if i == n_over - 1:
+                        h, _mask = y
+                        y = lm_head_apply(p[str(k)], h)
+                    return y, {}
+
+                fns.append(fn)
+            return fns
+
         reduce_axes = ("seq",) + d_axes
 
         def shard_step(ts: TrainState, ids, targets, lr):
@@ -435,36 +503,75 @@ class CausalLMSequenceParallelEngine:
             )
             ctx = L.Context(train=True, rng=rng, dtype=cdt, matmul=mm)
 
-            def loss_fn(params):
-                logits = forward(params, ids, ctx)
-                m = local_sums(logits, targets)
-                # LOCAL token-loss sum (pipeline discipline: no psum
-                # before grad).
-                return m["loss_sum"], m
-
-            (_, m), grads = jax.value_and_grad(loss_fn, has_aux=True)(
-                ts.params
-            )
-            n_global = lax.psum(m["count"], reduce_axes)
-            if bucketed:
-                # 'seq' first (complementary per-shard pieces — one
-                # fused psum over the TP-style axis), then the
-                # Reducer-style buckets over the data fabric(s).
-                grads = bucketed_psum(
-                    jax.tree_util.tree_map(
-                        lambda g: lax.psum(g, "seq"), grads
-                    ),
-                    ici_axis, dcn_axis, bucket_mb=bucket_mb,
+            if overlapped:
+                from distributed_model_parallel_tpu.models.staging import (
+                    partition_tree,
+                    stagewise_value_and_grad,
+                    unpartition_tree,
                 )
+
+                def loss_head(logits):
+                    m = local_sums(logits, targets)
+                    # LOCAL token-loss sum (pipeline discipline: no
+                    # psum before grad).
+                    return m["loss_sum"], m
+
+                def reduce_stage(k, stage_grads):
+                    # 'seq' first (complementary per-shard pieces),
+                    # then the Reducer buckets over the data fabric(s)
+                    # — fired while earlier segments still
+                    # differentiate.
+                    with jax.named_scope(f"grad_reduce_stage{k}"):
+                        return bucketed_psum(
+                            jax.tree_util.tree_map(
+                                lambda g: lax.psum(g, "seq"),
+                                stage_grads,
+                            ),
+                            ici_axis, dcn_axis, bucket_mb=bucket_mb,
+                        )
+
+                stage_params = partition_tree(ts.params, over_cuts)
+                _, m, stage_grads, _ = stagewise_value_and_grad(
+                    overlap_stage_fns(ctx), loss_head, stage_params,
+                    [None] * (len(over_cuts) - 1), ids,
+                    on_stage_grads=reduce_stage,
+                )
+                n_global = lax.psum(m["count"], reduce_axes)
                 grads = jax.tree_util.tree_map(
-                    lambda g: g / jnp.maximum(n_global, 1.0), grads
+                    lambda g: g / jnp.maximum(n_global, 1.0),
+                    unpartition_tree(stage_grads, over_cuts),
                 )
             else:
-                grads = jax.tree_util.tree_map(
-                    lambda g: lax.psum(g, reduce_axes)
-                    / jnp.maximum(n_global, 1.0),
-                    grads,
-                )
+                def loss_fn(params):
+                    logits = forward(params, ids, ctx)
+                    m = local_sums(logits, targets)
+                    # LOCAL token-loss sum (pipeline discipline: no psum
+                    # before grad).
+                    return m["loss_sum"], m
+
+                (_, m), grads = jax.value_and_grad(
+                    loss_fn, has_aux=True
+                )(ts.params)
+                n_global = lax.psum(m["count"], reduce_axes)
+                if bucketed:
+                    # 'seq' first (complementary per-shard pieces — one
+                    # fused psum over the TP-style axis), then the
+                    # Reducer-style buckets over the data fabric(s).
+                    grads = bucketed_psum(
+                        jax.tree_util.tree_map(
+                            lambda g: lax.psum(g, "seq"), grads
+                        ),
+                        ici_axis, dcn_axis, bucket_mb=bucket_mb,
+                    )
+                    grads = jax.tree_util.tree_map(
+                        lambda g: g / jnp.maximum(n_global, 1.0), grads
+                    )
+                else:
+                    grads = jax.tree_util.tree_map(
+                        lambda g: lax.psum(g, reduce_axes)
+                        / jnp.maximum(n_global, 1.0),
+                        grads,
+                    )
             params, opt_state = self.optimizer.update(
                 ts.params, ts.opt_state, grads, lr
             )

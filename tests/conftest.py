@@ -37,6 +37,13 @@ _EXPERIMENTS_IMPORT = re.compile(
 )
 
 
+# Budget-rebalance convention (PR 4): a test demoted to `slow` must name
+# its tier-1 twin in its docstring, so the default run's coverage story
+# stays auditable. A parametrized sweep whose non-slow cases keep running
+# in tier-1 is its own twin and needs no docstring note.
+_TWIN_RE = re.compile(r"tier-?1|twin", re.IGNORECASE)
+
+
 def pytest_collection_modifyitems(config, items):
     offenders = []
     checked = {}
@@ -54,6 +61,36 @@ def pytest_collection_modifyitems(config, items):
         raise pytest.UsageError(
             "tests importing experiments/ must be marked @pytest.mark.slow "
             "(tier-1 budget): " + ", ".join(sorted(offenders))
+        )
+
+    # slow-twin meta-check: group collected items by test function; a
+    # function whose EVERY case is slow must document its tier-1 twin.
+    # Only meaningful when whole files/dirs were collected: a direct
+    # node-id invocation (re-running one CI failure) can select a lone
+    # slow param of a mixed sweep, which would otherwise masquerade as
+    # an undocumented all-slow function and abort collection.
+    if any("::" in a for a in config.args):
+        return
+    by_fn = {}
+    for item in items:
+        key = (
+            str(getattr(item, "fspath", "")),
+            getattr(item, "originalname", item.name),
+        )
+        by_fn.setdefault(key, []).append(item)
+    undocumented = []
+    for (path, name), group in by_fn.items():
+        if any(i.get_closest_marker("slow") is None for i in group):
+            continue  # mixed sweep: the non-slow cases ARE the twin
+        fn = getattr(group[0], "function", None)
+        doc = getattr(fn, "__doc__", None) or ""
+        if not _TWIN_RE.search(doc):
+            undocumented.append(f"{path}::{name}")
+    if undocumented:
+        raise pytest.UsageError(
+            "slow-demoted tests must name their tier-1 twin in their "
+            "docstring (PR 4 budget-rebalance convention): "
+            + ", ".join(sorted(undocumented))
         )
 
 

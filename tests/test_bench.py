@@ -283,9 +283,12 @@ def test_reducer_sweep_failure_rescues_partial_legs(
     monkeypatch, capsys
 ):
     """The reducer sweep rides the same per-leg rescue convention as
-    the scaling/cm sweeps."""
+    the scaling/cm sweeps — including the overlapped pair's columns
+    (bwd_bucketed_ms / overlapped_ms), which are plain row keys to the
+    rescue path."""
     legs = [{"axis_size": 2, "naive_ms": 1.0, "bucketed_ms": 0.9,
-             "hierarchical_ms": 0.8}]
+             "hierarchical_ms": 0.8, "bwd_bucketed_ms": 1.2,
+             "overlapped_ms": 1.1}]
 
     def fake_spawn(args, timeout_s, env=None, **kw):
         out = "".join(

@@ -15,7 +15,13 @@ import pytest
 from distributed_model_parallel_tpu.cli import data_parallel, model_parallel
 
 
+@pytest.mark.slow
 def test_data_parallel_cli(tmp_path, monkeypatch):
+    """Default-engine (declarative DP) data_parallel CLI e2e. `slow`
+    (tier-1 budget); tier-1 twins: test_data_parallel_cli_ddp_syncbn
+    and test_data_parallel_cli_ddp_overlapped drive the same entry
+    point end to end (the DP engine's math stays pinned by
+    tests/test_data_parallel.py)."""
     monkeypatch.chdir(tmp_path)
     result = data_parallel.main([
         "--lr", "0.1",
@@ -134,6 +140,21 @@ def test_data_parallel_cli_ddp_bucketed_hierarchical(
     assert len(result["history"]) == 1
 
 
+def test_data_parallel_cli_ddp_overlapped(tmp_path, monkeypatch):
+    """--engine ddp --grad-reduction overlapped drives the full entry
+    point: stagewise backward (2 segments over tinycnn's 4 blocks) with
+    eager per-segment bucket firing on the hybrid dcn×ici mesh."""
+    monkeypatch.chdir(tmp_path)
+    result = data_parallel.main([
+        "--engine", "ddp", "--grad-reduction", "overlapped",
+        "--overlap-stages", "2", "--bucket-mb", "0.25",
+        "--dcn-slices", "2", "--model", "tinycnn",
+        "-type", "Synthetic", "-b", "64", "--val-batch-size", "128",
+        "--epochs", "1", "--steps-per-epoch", "2",
+    ])
+    assert len(result["history"]) == 1
+
+
 def test_grad_reduction_flag_guards():
     """Defaults stay monolithic/1-slice everywhere; misuse fails loudly
     instead of silently doing nothing."""
@@ -144,6 +165,7 @@ def test_grad_reduction_flag_guards():
     # bucket_mb parses as a None sentinel ("flag not passed");
     # check_grad_reduction_args resolves it to the 25 MB default.
     assert dp_args.dcn_slices == 1 and dp_args.bucket_mb is None
+    assert dp_args.overlap_stages is None
     lm_args = lm.build_parser().parse_args([])
     assert lm_args.grad_reduction == "monolithic"
     with pytest.raises(SystemExit):  # gspmd jit has no explicit site
@@ -185,6 +207,50 @@ def test_grad_reduction_flag_guards():
         ])
 
 
+def test_overlapped_flag_guards():
+    """--grad-reduction overlapped misuse fails fast (before datasets /
+    meshes) on both CLIs: declarative engines have no explicit
+    reduction site to re-stage, pipeline engines reduce over 'stage'
+    wires, a 1-layer model has no second segment, and --overlap-stages
+    is overlapped-only."""
+    from distributed_model_parallel_tpu.cli import lm
+
+    with pytest.raises(SystemExit):  # gspmd jit has no explicit site
+        data_parallel.main([
+            "--grad-reduction", "overlapped", "--model", "tinycnn",
+            "-type", "Synthetic",
+        ])
+    with pytest.raises(SystemExit):  # neither does tp
+        data_parallel.main([
+            "--engine", "tp", "--grad-reduction", "overlapped",
+            "--model", "bert_tiny", "-type", "SyntheticText",
+        ])
+    with pytest.raises(SystemExit):  # --overlap-stages is overlapped-only
+        data_parallel.main([
+            "--engine", "ddp", "--overlap-stages", "2",
+            "--model", "tinycnn", "-type", "Synthetic",
+        ])
+    with pytest.raises(SystemExit):  # < 2 segments is the monolithic bwd
+        data_parallel.main([
+            "--engine", "ddp", "--grad-reduction", "overlapped",
+            "--overlap-stages", "1", "--model", "tinycnn",
+            "-type", "Synthetic",
+        ])
+    with pytest.raises(SystemExit):  # pipeline mode reduces over wires
+        lm.main([
+            "--pipeline-stages", "2", "--grad-reduction", "overlapped",
+        ])
+    with pytest.raises(SystemExit):  # 1 decoder layer: nothing to overlap
+        lm.main([
+            "--grad-reduction", "overlapped", "--layers", "1",
+        ])
+    with pytest.raises(SystemExit):  # more segments than decoder blocks
+        lm.main([
+            "--grad-reduction", "overlapped", "--layers", "2",
+            "--overlap-stages", "4",
+        ])
+
+
 @pytest.mark.slow
 def test_lm_cli_bucketed(tmp_path, monkeypatch):
     """The lm CLI's --grad-reduction bucketed reaches the causal-LM
@@ -197,6 +263,27 @@ def test_lm_cli_bucketed(tmp_path, monkeypatch):
     result = lm.main([
         "--seq-shards", "2", "--grad-reduction", "bucketed",
         "--bucket-mb", "0.25", "--dcn-slices", "2",
+        "--dim", "32", "--layers", "2", "--heads", "4",
+        "--ffn-dim", "64", "--seq-len", "32",
+        "-b", "8", "--epochs", "1", "--steps-per-epoch", "2",
+        "--corpus-tokens", "4096", "--lr", "1e-3",
+    ])
+    assert len(result["history"]) == 1
+
+
+@pytest.mark.slow
+def test_lm_cli_overlapped(tmp_path, monkeypatch):
+    """The lm CLI's --grad-reduction overlapped reaches the causal-LM
+    sequence-parallel engine end-to-end (stagewise 'seq' psum + eager
+    data buckets). `slow`; tier-1 twins: the engine-level parity case
+    tests/test_grad_reduction.py::test_causal_lm_sp_overlapped_matches_
+    monolithic and the data_parallel overlapped CLI row above."""
+    from distributed_model_parallel_tpu.cli import lm
+
+    monkeypatch.chdir(tmp_path)
+    result = lm.main([
+        "--seq-shards", "2", "--grad-reduction", "overlapped",
+        "--overlap-stages", "2", "--bucket-mb", "0.25",
         "--dim", "32", "--layers", "2", "--heads", "4",
         "--ffn-dim", "64", "--seq-len", "32",
         "-b", "8", "--epochs", "1", "--steps-per-epoch", "2",
@@ -225,7 +312,13 @@ def test_lm_cli_collective_matmul(tmp_path, monkeypatch):
     assert len(result["history"]) == 1
 
 
+@pytest.mark.slow
 def test_model_parallel_cli(tmp_path, monkeypatch):
+    """Default-schedule (gpipe) model_parallel CLI e2e incl. the
+    log/64.txt side effect. `slow` (tier-1 budget); tier-1 twin:
+    test_model_parallel_cli_1f1b drives the same entry point end to end
+    (gpipe engine math stays pinned by the tests/test_pipeline.py
+    engine rows)."""
     monkeypatch.chdir(tmp_path)
     result = model_parallel.main([
         "./data",
@@ -262,11 +355,16 @@ def test_model_parallel_cli_1f1b(tmp_path, monkeypatch):
     assert len(result["history"]) == 1
 
 
+@pytest.mark.slow
 def test_model_parallel_cli_interleaved(tmp_path, monkeypatch):
     """--pipeline-schedule interleaved --virtual-stages 2 drives the
     full entry point: 2 physical stages x 2 chunks = a 4-way tinycnn
     split dealt round-robin, ring-routed activations, train + eval
-    epochs."""
+    epochs. `slow` (tier-1 budget); tier-1 twins:
+    test_model_parallel_cli_1f1b (same entry point + schedule-flag
+    plumbing) and test_pipeline_schedule.py::
+    test_interleaved_matches_gpipe_1f1b_and_dense_smoke (the
+    interleaved engine math)."""
     monkeypatch.chdir(tmp_path)
     result = model_parallel.main([
         "./data",

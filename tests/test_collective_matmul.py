@@ -175,7 +175,11 @@ def _assert_state_close(ts_a, ts_b, rtol=1e-5, atol=1e-6):
         )
 
 
-@pytest.mark.parametrize("tp", [2, 4, 8])
+# S=8 rides slow (tier-1 budget): S=4 already exercises multi-hop rings
+# and the S-sweep's 8-way case runs in the full suite.
+@pytest.mark.parametrize(
+    "tp", [2, 4, pytest.param(8, marks=pytest.mark.slow)]
+)
 def test_tp_collective_matmul_matches_declarative(tp):
     """TensorParallelEngine(collective_matmul=True) == the declarative
     engine: same per-step loss/acc metrics and the same parameters after
@@ -239,7 +243,10 @@ def test_tp_collective_matmul_rejects_indivisible_seq():
 # ------------------------------------------------- SP engine parity
 
 
-@pytest.mark.parametrize("sp", [2, 4, 8])
+# S=8 rides slow (tier-1 budget), same rationale as the TP sweep above.
+@pytest.mark.parametrize(
+    "sp", [2, 4, pytest.param(8, marks=pytest.mark.slow)]
+)
 def test_sp_collective_matmul_matches_ring_engine(sp):
     """SequenceParallelEngine(collective_matmul=True) == the plain ring
     engine (and therefore dense, by the existing SP parity pins):
@@ -266,9 +273,13 @@ def test_sp_collective_matmul_matches_ring_engine(sp):
     _assert_state_close(ts_c, ts_r)
 
 
+@pytest.mark.slow
 def test_lm_sp_collective_matmul_matches_ring_engine():
     """The decoder-side twin: CausalLMSequenceParallelEngine with the
-    FFN rings matches its plain-ring self step for step."""
+    FFN rings matches its plain-ring self step for step. `slow` (tier-1
+    budget); tier-1 twins: test_sp_collective_matmul_matches_ring_engine
+    (the encoder SP engine, same FFN ring path over 'seq') and the
+    structural SP permute-chain pins in tests/test_collectives_hlo.py."""
     from distributed_model_parallel_tpu.models.gpt import GPTConfig
     from distributed_model_parallel_tpu.parallel.sequence_parallel import (
         CausalLMSequenceParallelEngine,

@@ -533,6 +533,217 @@ def test_fsdp_bucketed_step_gathers_weights_and_rings_grads():
     assert _nonscalar_all_reduce_count(hlo) == 0
 
 
+# ------------------------------------- overlapped backward (deps)
+# The stagewise-backward reducer (`grad_reduction="overlapped"`): the
+# eager firing is verified STRUCTURALLY, from the dependency graph of
+# the compiled HLO — the first-fired bucket's ring collectives (the
+# LAST stage's, late layers first) must have no transitive dependency
+# on stage 0's backward ops, and the FSDP prefetch all-gather for stage
+# k-1 must not depend on any stage's bucket rings. Instructions are
+# identified by the `jax.named_scope` tags the engines trace them
+# under (`grad_reduce_stage{k}`, `bwd_stage{k}`,
+# `prefetch_gather_stage{k}` — carried into compiled HLO as
+# metadata op_name).
+
+
+def _hlo_graph(hlo: str):
+    """(computations, instructions) from compiled-HLO text.
+
+    `computations` maps a computation name to its instruction names;
+    `instructions` maps an instruction name to (op, referenced names,
+    op_name metadata). Referenced names include operands AND called
+    computations (fusion bodies, reduction regions), so reachability
+    over this graph is a conservative over-approximation of data
+    dependence — exactly the safe direction for asserting the ABSENCE
+    of a dependency."""
+    comps: dict = {}
+    instrs: dict = {}
+    current = None
+    for line in hlo.splitlines():
+        s = line.strip()
+        if s.endswith("{") and "= " not in s:
+            m = re.match(r"(?:ENTRY\s+)?%?([\w.\-]+)\s*\(", s)
+            if m:
+                current = m.group(1)
+                comps[current] = []
+                continue
+        if s == "}":
+            current = None
+            continue
+        m = re.match(
+            rf"(?:ROOT\s+)?%([\w.\-]+)\s*=\s*{_RESULT}\s+([\w\-]+)\(", s
+        )
+        if m and current is not None:
+            name, op = m.groups()
+            meta = re.search(r'op_name="([^"]*)"', s)
+            refs = set(re.findall(r"%([\w.\-]+)", s)) - {name}
+            instrs[name] = (op, refs, meta.group(1) if meta else "")
+            comps[current].append(name)
+    return comps, instrs
+
+
+def _depends_on(comps, instrs, start, targets) -> bool:
+    """True when `start` transitively references any name in `targets`
+    (through operands and called computations)."""
+    seen, stack = set(), [start]
+    while stack:
+        n = stack.pop()
+        if n in seen:
+            continue
+        seen.add(n)
+        if n in targets and n != start:
+            return True
+        _, refs, _ = instrs.get(n, (None, set(), ""))
+        for r in refs:
+            if r in comps:
+                stack.extend(comps[r])
+            elif r in instrs:
+                stack.append(r)
+    return False
+
+
+def _tagged(instrs, tag, op_prefix=None):
+    """Instruction names whose op_name metadata carries `tag` (a
+    named-scope segment, matched with its trailing '/' so stage1 never
+    matches stage10), optionally filtered by op prefix."""
+    return [
+        n for n, (op, _, meta) in instrs.items()
+        if f"{tag}/" in meta
+        and (op_prefix is None or op.startswith(op_prefix))
+    ]
+
+
+def _staged_mlp(n_blocks=8, width=32, classes=4):
+    """BN-free stem/blocks/head MLP (staging.staged_model anatomy):
+    no model_state, so the only collectives in an overlapped DDP step
+    are the bucket rings and the scalar metrics psums — and 8 blocks
+    support every S in {2, 4, 8}."""
+    from distributed_model_parallel_tpu.models import layers as L
+    from distributed_model_parallel_tpu.models import staging
+
+    stem = L.sequential(L.flatten(), L.linear(192, width), L.relu())
+    blocks = [
+        L.sequential(L.linear(width, width), L.relu())
+        for _ in range(n_blocks)
+    ]
+    return staging.staged_model(stem, blocks, L.linear(width, classes))
+
+
+@pytest.mark.parametrize("s", [2, 4, 8])
+def test_ddp_overlapped_first_bucket_free_of_stage0_backward(s):
+    """The ISSUE's tentpole pin: with grad_reduction='overlapped' and S
+    backward segments, the FIRST-fired bucket's ring permutes (stage
+    S-1's — late layers differentiate first) have NO transitive
+    dependency on stage 0's backward ops, so XLA may schedule them
+    beside the remaining backward. Positive control: stage 0's own
+    bucket (fired last) MUST depend on stage 0's backward."""
+    from distributed_model_parallel_tpu.parallel.data_parallel import (
+        DDPEngine,
+    )
+
+    mesh = make_mesh(MeshSpec(data=8))
+    eng = DDPEngine(
+        _staged_mlp(8), SGD(), mesh, donate=False,
+        grad_reduction="overlapped", overlap_stages=s, bucket_mb=0.001,
+    )
+    ts = eng.init_state(jax.random.PRNGKey(0))
+    im, lb = eng.shard_batch(*_batch(16))
+    hlo = _hlo(eng, ts, im, lb, jnp.float32(0.1))
+    comps, instrs = _hlo_graph(hlo)
+
+    first = _tagged(
+        instrs, f"grad_reduce_stage{s - 1}", "collective-permute"
+    )
+    bwd0 = set(_tagged(instrs, "bwd_stage0"))
+    assert first, "first-fired bucket emitted no ring permutes"
+    assert bwd0, "stage 0 backward left no tagged ops"
+    for p in first:
+        assert not _depends_on(comps, instrs, p, bwd0), (
+            f"S={s}: first bucket permute {p} depends on stage-0 "
+            "backward — the eager firing serialized"
+        )
+    # Positive control — the dependency analysis is not vacuous.
+    last = _tagged(instrs, "grad_reduce_stage0", "collective-permute")
+    assert last and all(
+        _depends_on(comps, instrs, p, bwd0) for p in last
+    )
+
+
+def test_ddp_overlapped_keeps_ring_structure_and_no_grad_all_reduce():
+    """The overlapped step keeps the bucketed lowering per segment:
+    2(S_data-1) permutes per bucket summed over the per-stage bucket
+    plans, zero monolithic all-gather/reduce-scatter, zero grad-sized
+    all-reduce."""
+    from distributed_model_parallel_tpu.models import staging
+    from distributed_model_parallel_tpu.ops.grad_reduction import (
+        plan_buckets,
+    )
+    from distributed_model_parallel_tpu.parallel.data_parallel import (
+        DDPEngine,
+    )
+
+    mesh = make_mesh(MeshSpec(data=8))
+    bucket_mb = 0.001
+    model = _staged_mlp(8)
+    eng = DDPEngine(
+        model, SGD(), mesh, donate=False,
+        grad_reduction="overlapped", overlap_stages=4,
+        bucket_mb=bucket_mb,
+    )
+    ts = eng.init_state(jax.random.PRNGKey(0))
+    im, lb = eng.shard_batch(*_batch(16))
+    hlo = _hlo(eng, ts, im, lb, jnp.float32(0.1))
+
+    key_aval = jax.ShapeDtypeStruct((2,), jnp.uint32)
+    p_aval, _ = jax.eval_shape(model.init, key_aval)
+    cuts = staging.split_points(4, None, 8)
+    n_buckets = sum(
+        len(plan_buckets(jax.tree_util.tree_leaves(sp), bucket_mb))
+        for sp in staging.partition_tree(p_aval, cuts)
+    )
+    assert n_buckets >= 5  # per-stage plans actually split the pytree
+    c = _collective_counts(hlo)
+    assert c["collective-permute"] == 2 * (8 - 1) * n_buckets
+    assert c["all-gather"] == 0 and c["reduce-scatter"] == 0
+    assert _nonscalar_all_reduce_count(hlo) == 0
+
+
+@pytest.mark.parametrize("s", [2, 4, 8])
+def test_fsdp_overlapped_prefetch_gather_free_of_reduce(s):
+    """ZeRO overlap pin: the backward loop's prefetched all-gather of
+    stage k-1's weights (issued during stage k's backward) depends only
+    on the parameter shards — never on ANY stage's bucket rings (a
+    superset of the ISSUE's 'not on stage k's reduce-scatter'), so the
+    scheduler may hoist it behind the in-flight reduction."""
+    from distributed_model_parallel_tpu.parallel.fsdp import FSDPEngine
+
+    mesh = make_mesh(MeshSpec(data=8))
+    eng = FSDPEngine(
+        _staged_mlp(8, width=128), SGD(), mesh, donate=False,
+        min_shard_elems=64, grad_reduction="overlapped",
+        overlap_stages=s, bucket_mb=0.02,
+    )
+    ts = eng.init_state(jax.random.PRNGKey(0))
+    im, lb = eng.shard_batch(*_batch(64))
+    hlo = _hlo(eng, ts, im, lb, jnp.float32(0.1))
+    comps, instrs = _hlo_graph(hlo)
+
+    reduce_ops = set(_tagged(instrs, "grad_reduce_stage0"))
+    for k in range(s):
+        reduce_ops |= set(_tagged(instrs, f"grad_reduce_stage{k}"))
+    assert reduce_ops
+    for k in range(s - 1):
+        gathers = _tagged(
+            instrs, f"prefetch_gather_stage{k}", "all-gather"
+        )
+        assert gathers, f"no prefetched all-gather for stage {k}"
+        for g in gathers:
+            assert not _depends_on(comps, instrs, g, reduce_ops), (
+                f"S={s}: prefetch gather {g} (stage {k}) depends on a "
+                "bucket reduction — the ZeRO overlap serialized"
+            )
+
+
 def test_sp_ulysses_step_contains_all_to_all():
     from distributed_model_parallel_tpu.models.bert import BertConfig
     from distributed_model_parallel_tpu.parallel.sequence_parallel import (

@@ -174,6 +174,9 @@ def test_ddp_local_bn_differs_but_converges_shape_tiny(meshes, rng):
 
 @pytest.mark.slow
 def test_ddp_local_bn_differs_but_converges_shape(meshes, rng):
+    """Full MobileNetV2 twin of the tier-1
+    test_ddp_local_bn_differs_but_converges_shape_tiny (same assertions
+    on tiny_cnn)."""
     _local_bn_step(mobilenet_v2(10), meshes, rng)
 
 
@@ -196,4 +199,7 @@ def test_multi_step_loss_decreases_tiny(meshes, rng):
 
 @pytest.mark.slow
 def test_multi_step_loss_decreases(meshes, rng):
+    """Full MobileNetV2 twin of the tier-1
+    test_multi_step_loss_decreases_tiny (same convergence smoke on
+    tiny_cnn)."""
     _loss_decreases(mobilenet_v2(10), meshes, rng)

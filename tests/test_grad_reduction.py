@@ -369,7 +369,9 @@ def test_ddp_bucketed_first_step_grads_match(devices):
 @pytest.mark.slow
 def test_ddp_bucketed_hybrid_matches_plain_mesh_trajectory(devices):
     """The dcn×ici factoring is a LAYOUT, not math: the bucketed
-    trajectory on the hybrid mesh equals the plain-mesh one."""
+    trajectory on the hybrid mesh equals the plain-mesh one. Tier-1
+    twin: test_ddp_bucketed_matches_monolithic_on_hybrid_mesh's S=8
+    case pins the hybrid path against monolithic at the same rtol."""
     plain = make_mesh(MeshSpec(data=8))
     hybrid = make_mesh(MeshSpec(data=8, dcn=2))
     trajs = {}
@@ -473,6 +475,283 @@ def test_engine_rejects_unknown_grad_reduction(devices):
     mesh = make_mesh(MeshSpec(data=8))
     with pytest.raises(ValueError, match="grad_reduction"):
         DDPEngine(tiny_cnn(10), SGD(), mesh, grad_reduction="fused")
+
+
+# ------------------------------------- stagewise backward (overlapped)
+# The `grad_reduction="overlapped"` substrate
+# (`models/staging.stagewise_value_and_grad`): chained per-stage vjp
+# closures must equal the monolithic `jax.grad` BIT FOR BIT on a
+# single-device no-collective model — so an engine-level parity failure
+# localizes to the collectives, never to the chain itself.
+
+
+def _stagewise_grads(model, cuts, params, state, x, ctx,
+                     on_stage_grads=None):
+    from distributed_model_parallel_tpu.models import staging
+    from distributed_model_parallel_tpu.parallel.data_parallel import (
+        aux_loss,
+    )
+
+    def loss_head(y):
+        loss = jnp.sum(y.astype(jnp.float32) ** 2)
+        return loss, y
+
+    loss, _, grads, new_states = staging.stagewise_value_and_grad(
+        staging.stage_apply_fns(model.parts, cuts, ctx),
+        loss_head,
+        staging.partition_tree(params, cuts),
+        staging.partition_tree(state, cuts),
+        x,
+        aux_of_state=aux_loss,
+        on_stage_grads=on_stage_grads,
+    )
+    return (
+        loss,
+        staging.unpartition_tree(grads, cuts),
+        staging.unpartition_tree(new_states, cuts),
+    )
+
+
+@pytest.mark.parametrize("remat", [False, True])
+def test_stagewise_vjp_matches_jax_grad_bitwise(remat):
+    """Single device, no collectives: the chained per-stage vjp equals
+    `jax.grad` bit for bit — including `remat=True` blocks (the
+    checkpointed recompute happens inside each stage closure) and the
+    BN batch-stat side outputs (tiny_cnn's model_state), which must
+    reassemble to exactly the monolithic apply's new_state."""
+    from distributed_model_parallel_tpu.models import layers as L
+    from distributed_model_parallel_tpu.models import staging
+    from distributed_model_parallel_tpu.parallel.data_parallel import (
+        aux_loss,
+    )
+
+    model = tiny_cnn(10, remat=remat)
+    params, state = model.init(jax.random.PRNGKey(0))
+    rng = np.random.RandomState(3)
+    x = jnp.asarray(rng.rand(4, 8, 8, 3), jnp.float32)
+    ctx = L.Context(train=True)
+    cuts = staging.split_points(3, None, len(model.parts.blocks))
+
+    loss_s, grads_s, state_s = jax.jit(
+        lambda p: _stagewise_grads(model, cuts, p, state, x, ctx)
+    )(params)
+
+    def mono(p):
+        y, new_state = model.apply(p, state, x, ctx)
+        return (
+            jnp.sum(y.astype(jnp.float32) ** 2) + aux_loss(new_state),
+            new_state,
+        )
+
+    (loss_m, state_m), grads_m = jax.jit(
+        jax.value_and_grad(mono, has_aux=True)
+    )(params)
+
+    assert np.asarray(loss_s) == np.asarray(loss_m)
+    for a, b in zip(
+        jax.tree_util.tree_leaves(grads_s),
+        jax.tree_util.tree_leaves(grads_m),
+    ):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    for a, b in zip(
+        jax.tree_util.tree_leaves(state_s),
+        jax.tree_util.tree_leaves(state_m),
+    ):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_stagewise_hook_sees_stages_in_reverse():
+    """The Reducer contract: `on_stage_grads` fires late stages first,
+    once per stage, with that stage's partition-layout grads."""
+    from distributed_model_parallel_tpu.models import layers as L
+    from distributed_model_parallel_tpu.models import staging
+
+    model = tiny_cnn(10)
+    params, state = model.init(jax.random.PRNGKey(0))
+    x = jnp.ones((2, 8, 8, 3), jnp.float32)
+    cuts = staging.split_points(4, None, 4)
+    order = []
+
+    def hook(k, g):
+        order.append(k)
+        return g
+
+    _stagewise_grads(
+        model, cuts, params, state, x, L.Context(train=True),
+        on_stage_grads=hook,
+    )
+    assert order == [3, 2, 1, 0]
+
+
+def test_unpartition_tree_roundtrips():
+    from distributed_model_parallel_tpu.models import staging
+
+    model = tiny_cnn(10)
+    params, _ = model.init(jax.random.PRNGKey(0))
+    for n_stages in (2, 3, 4):
+        cuts = staging.split_points(n_stages, None, 4)
+        back = staging.unpartition_tree(
+            staging.partition_tree(params, cuts), cuts
+        )
+        assert jax.tree_util.tree_structure(
+            back
+        ) == jax.tree_util.tree_structure(params)
+        for a, b in zip(
+            jax.tree_util.tree_leaves(back),
+            jax.tree_util.tree_leaves(params),
+        ):
+            assert a is b
+
+
+def test_resolve_overlap_stages_validates():
+    from distributed_model_parallel_tpu.models import staging
+
+    parts = tiny_cnn(10).parts
+    assert staging.resolve_overlap_stages(parts, 0, "t") == 4
+    assert staging.resolve_overlap_stages(parts, 2, "t") == 2
+    with pytest.raises(ValueError, match="overlap_stages"):
+        staging.resolve_overlap_stages(parts, 1, "t")
+    with pytest.raises(ValueError, match="overlap_stages"):
+        staging.resolve_overlap_stages(parts, 5, "t")
+    with pytest.raises(ValueError, match="parts"):
+        staging.resolve_overlap_stages(None, 0, "t")
+
+
+# --------------------------------------- overlapped engine parity
+# Same sweep-vs-smoke pattern as the bucketed rows above: the hybrid
+# 2×(S/2) mesh (covering the hierarchical path) is the tier-1 smoke;
+# plain-mesh twins ride the slow sweep.
+
+
+@pytest.mark.parametrize("dcn", _MESH_SWEEP)
+def test_ddp_overlapped_matches_bucketed_and_monolithic(dcn, devices):
+    """Grads (via the 3-step trajectory + final params), metrics — all
+    three reducers agree at rtol 1e-5, plain and hybrid mesh."""
+    mesh = make_mesh(MeshSpec(data=8, dcn=dcn))
+    res = {}
+    for gr in ("monolithic", "bucketed", "overlapped"):
+        eng = DDPEngine(
+            tiny_cnn(10), SGD(), mesh, donate=False,
+            grad_reduction=gr, bucket_mb=0.02,
+        )
+        res[gr] = _run(eng)
+    for gr in ("bucketed", "overlapped"):
+        np.testing.assert_allclose(
+            res[gr][1], res["monolithic"][1], rtol=1e-5
+        )
+        _tree_close(res[gr][0].params, res["monolithic"][0].params)
+        _tree_close(res[gr][2], res["monolithic"][2],
+                    rtol=1e-5, atol=1e-5)
+
+
+@pytest.mark.parametrize("dcn", _MESH_SWEEP)
+def test_fsdp_overlapped_matches_monolithic_and_stays_sharded(
+    dcn, devices
+):
+    """The stagewise ZeRO step: trajectory parity with the declarative
+    engine AND the 1/N at-rest sharding of params + moments preserved
+    (the regather-in-backward must not widen the stored state)."""
+    from distributed_model_parallel_tpu.parallel.fsdp import FSDPEngine
+    from distributed_model_parallel_tpu.training.optim import AdamW
+
+    mesh = make_mesh(MeshSpec(data=8, dcn=dcn))
+    res = {}
+    for gr in ("monolithic", "overlapped"):
+        eng = FSDPEngine(
+            tiny_cnn(10), AdamW(), mesh, donate=False,
+            min_shard_elems=64, grad_reduction=gr, bucket_mb=0.02,
+        )
+        res[gr] = _run(eng, lr=1e-3)
+    np.testing.assert_allclose(
+        res["overlapped"][1], res["monolithic"][1], rtol=1e-5
+    )
+    _tree_close(res["overlapped"][0].params,
+                res["monolithic"][0].params)
+    big = max(
+        jax.tree_util.tree_leaves(res["overlapped"][0].params),
+        key=lambda l: l.size,
+    )
+    assert np.prod(big.addressable_shards[0].data.shape) == (
+        big.size // 8
+    )
+    mu = max(
+        jax.tree_util.tree_leaves(res["overlapped"][0].opt_state.mu),
+        key=lambda l: l.size,
+    )
+    assert np.prod(mu.addressable_shards[0].data.shape) == (
+        mu.size // 8
+    )
+
+
+@pytest.mark.parametrize("dcn", _MESH_SWEEP)
+def test_causal_lm_sp_overlapped_matches_monolithic(dcn, devices):
+    """The lm CLI's engine: stagewise 'seq' psum + eager data buckets
+    match the fused psum path, plain and hybrid."""
+    from distributed_model_parallel_tpu.models.gpt import GPTConfig
+    from distributed_model_parallel_tpu.parallel.sequence_parallel import (
+        CausalLMSequenceParallelEngine,
+    )
+    from distributed_model_parallel_tpu.training.optim import AdamW
+
+    cfg = GPTConfig(
+        vocab_size=64, dim=32, num_layers=2, num_heads=4, ffn_dim=64,
+        max_position=32, dropout_rate=0.0, pad_token_id=0,
+    )
+    rng = np.random.RandomState(0)
+    ids = rng.randint(1, 64, size=(8, 32)).astype(np.int32)
+    mesh = make_mesh(MeshSpec(data=4, seq=2, dcn=dcn))
+    res = {}
+    for gr in ("monolithic", "overlapped"):
+        eng = CausalLMSequenceParallelEngine(
+            cfg, AdamW(), mesh, donate=False,
+            grad_reduction=gr, bucket_mb=0.02,
+        )
+        ts = eng.init_state(jax.random.PRNGKey(0))
+        a, b = eng.shard_batch(ids)
+        traj = []
+        for _ in range(3):
+            ts, m = eng.train_step(ts, a, b, jnp.float32(1e-3))
+            traj.append(float(m["loss_sum"]))
+        res[gr] = (ts, traj)
+    np.testing.assert_allclose(
+        res["overlapped"][1], res["monolithic"][1], rtol=1e-5
+    )
+    _tree_close(res["overlapped"][0].params,
+                res["monolithic"][0].params, rtol=1e-4)
+
+
+def test_overlapped_engine_construction_guards(devices):
+    """Misuse fails at construction, not an epoch in: a model without
+    stage anatomy, a 1-segment cut, more segments than blocks, and a
+    1-layer LM."""
+    from distributed_model_parallel_tpu.models import layers as L
+    from distributed_model_parallel_tpu.models.gpt import GPTConfig
+    from distributed_model_parallel_tpu.parallel.fsdp import FSDPEngine
+    from distributed_model_parallel_tpu.parallel.sequence_parallel import (
+        CausalLMSequenceParallelEngine,
+    )
+
+    mesh = make_mesh(MeshSpec(data=8))
+    partless = L.sequential(
+        L.flatten(), L.linear(192, 16), L.linear(16, 10)
+    )
+    with pytest.raises(ValueError, match="parts"):
+        DDPEngine(partless, SGD(), mesh, grad_reduction="overlapped")
+    with pytest.raises(ValueError, match="overlap_stages"):
+        DDPEngine(tiny_cnn(10), SGD(), mesh,
+                  grad_reduction="overlapped", overlap_stages=1)
+    with pytest.raises(ValueError, match="overlap_stages"):
+        FSDPEngine(tiny_cnn(10), SGD(), mesh,
+                   grad_reduction="overlapped", overlap_stages=9)
+    cfg1 = GPTConfig(
+        vocab_size=64, dim=16, num_layers=1, num_heads=2, ffn_dim=32,
+        max_position=16, dropout_rate=0.0, pad_token_id=0,
+    )
+    smesh = make_mesh(MeshSpec(data=4, seq=2))
+    with pytest.raises(ValueError, match="num_layers"):
+        CausalLMSequenceParallelEngine(
+            cfg1, SGD(), smesh, grad_reduction="overlapped"
+        )
 
 
 if __name__ == "__main__":

@@ -24,13 +24,26 @@ def _param_count(tree):
     return sum(x.size for x in jax.tree_util.tree_leaves(tree))
 
 
+@pytest.mark.slow
 def test_mobilenetv2_shapes(rng):
+    """Full BN-variant MobileNetV2 forward shape. `slow` (tier-1
+    budget); tier-1 twins: test_mobilenetv2_param_count (the torch
+    param-count pin, init only) and test_mobilenetv2_nobn_shapes (the
+    forward shape on the BN-free variant)."""
     model = mobilenet_v2(num_classes=10)
     params, state = model.init(rng)
     x = jax.random.normal(jax.random.PRNGKey(1), (2, 32, 32, 3))
     y, new_state = model.apply(params, state, x, Context(train=False))
     assert y.shape == (2, 10)
     # torch MobileNetV2(num_classes=10) has 2,296,922 params; ours must match.
+    assert _param_count(params) == 2_296_922
+
+
+def test_mobilenetv2_param_count(rng):
+    # torch MobileNetV2(num_classes=10) has 2,296,922 params; ours must
+    # match (init only — the BN-variant forward compile rides the slow
+    # test_mobilenetv2_shapes).
+    params, _ = mobilenet_v2(num_classes=10).init(rng)
     assert _param_count(params) == 2_296_922
 
 

@@ -140,7 +140,9 @@ def test_pipeline_learns_tinycnn(pp_mesh):
 @pytest.mark.slow
 def test_pipeline_learns_mobilenet(pp_mesh):
     """Convergence smoke on the real flagship split: MobileNetV2 with the
-    reference's exact ws=4 boundaries (`model_parallel.py:102-144`)."""
+    reference's exact ws=4 boundaries (`model_parallel.py:102-144`).
+    Tier-1 twin: test_pipeline_learns (the same _pipeline_learns
+    assertions on the tiny stages)."""
     stages = mobilenetv2.split_stages(4, num_classes=4, boundaries=[3, 9, 15])
     _pipeline_learns(stages, pp_mesh, hw=32)
 
@@ -169,9 +171,14 @@ def bn_stages(num_classes=4):
     ]
 
 
+@pytest.mark.slow
 def test_pipeline_bn_microbatch_state_and_grads_match_sequential(pp_mesh):
     """Direct numerical test of pipeline+BN microbatching (VERDICT.md round
-    1, next-round item 7): with M microbatches on a (data=2, stage=4) mesh,
+    1, next-round item 7). `slow` (tier-1 budget); tier-1 twins:
+    test_stage_local_matches_replicated[bn_stages] (BN stages, same
+    mesh) and test_pipeline_schedule.py::
+    test_1f1b_bn_running_stats_match_gpipe (the BN microbatch fold).
+    With M microbatches on a (data=2, stage=4) mesh,
 
     * each stage's BN running stats must equal the SEQUENTIAL fold of the
       per-(shard, microbatch) updates, pmean-ed over 'data' (sync_bn=False

@@ -319,6 +319,8 @@ def test_1f1b_matches_gpipe_and_dense_s2(M):
 @pytest.mark.slow
 @pytest.mark.parametrize("S,M", [(2, 8), (4, 1), (4, 4), (4, 8)])
 def test_1f1b_matches_gpipe_and_dense_large(S, M):
+    """Tier-1 twin: test_1f1b_matches_gpipe_and_dense (the S=2 smoke
+    cases of the same assert_schedule_parity sweep)."""
     assert_schedule_parity(S=S, M=M)
 
 
@@ -334,6 +336,8 @@ def test_1f1b_remat_parity():
 @pytest.mark.parametrize("stage_local,remat", [(True, False), (False, True),
                                                (True, True)])
 def test_1f1b_stage_local_remat_parity_s4(stage_local, remat):
+    """Tier-1 twins: test_1f1b_stage_local_params_parity and
+    test_1f1b_remat_parity (the S=2,M=4 cases of the same harness)."""
     assert_schedule_parity(S=4, M=8, stage_local=stage_local, remat=remat)
 
 
@@ -409,16 +413,24 @@ def test_interleaved_matches_gpipe_1f1b_and_dense_smoke():
      (4, 2, 8)],
 )
 def test_interleaved_matches_gpipe_1f1b_and_dense_sweep(S, V, M):
+    """Tier-1 twin: test_interleaved_matches_gpipe_1f1b_and_dense (the
+    S=2,V=2,M=4 smoke case of the same assert_interleaved_parity)."""
     assert_interleaved_parity(S=S, V=V, M=M)
 
 
 @pytest.mark.slow
 def test_interleaved_stage_local_params_parity():
+    """Tier-1 twin: test_interleaved_matches_gpipe_1f1b_and_dense plus
+    the stage-local checkpoint roundtrip's structural coverage — this
+    adds the stage_local flag on the same S=2,V=2,M=4 harness."""
     assert_interleaved_parity(S=2, V=2, M=4, stage_local=True)
 
 
 @pytest.mark.slow
 def test_interleaved_remat_parity():
+    """Tier-1 twin: test_interleaved_matches_gpipe_1f1b_and_dense (the
+    same S=2,V=2,M=4 harness without the remat flag; remat×pipeline
+    parity stays in tier-1 via test_1f1b_remat_parity)."""
     assert_interleaved_parity(S=2, V=2, M=4, remat=True)
 
 
@@ -426,6 +438,8 @@ def test_interleaved_remat_parity():
 @pytest.mark.parametrize("stage_local,remat", [(True, False), (False, True),
                                                (True, True)])
 def test_interleaved_stage_local_remat_parity_s4(stage_local, remat):
+    """Tier-1 twin: test_interleaved_matches_gpipe_1f1b_and_dense (the
+    S=2,V=2,M=4 smoke of the same harness; flags covered slow-only)."""
     assert_interleaved_parity(
         S=4, V=2, M=8, stage_local=stage_local, remat=remat
     )
@@ -434,7 +448,9 @@ def test_interleaved_stage_local_remat_parity_s4(stage_local, remat):
 @pytest.mark.slow
 def test_interleaved_bn_trajectory_matches_grouped_gpipe():
     """3-step trajectory with BatchNorm: the interleaved engine (S=2
-    devices × V=2 BN chunks) against a gpipe engine on the SAME mesh
+    devices × V=2 BN chunks) — tier-1 twin:
+    test_interleaved_matches_gpipe_1f1b_and_dense (BN-free parity on
+    the same schedule) — against a gpipe engine on the SAME mesh
     whose stages are the same chunks grouped contiguously (stage i =
     chunks 2i, 2i+1) with params/state TRANSPLANTED from the interleaved
     init — same data-parallel width, same microbatch contents, so BN
@@ -514,7 +530,9 @@ def test_interleaved_bn_trajectory_matches_grouped_gpipe():
 
 @pytest.mark.slow
 def test_interleaved_stage_local_checkpoint_canonical_roundtrip():
-    """The device-major row permutation (`staging.row_of_logical`) under
+    """Tier-1 twin: test_1f1b/interleaved smoke parity plus
+    test_pipeline.py's replicated checkpoint coverage. The device-major
+    row permutation (`staging.row_of_logical`) under
     stage_local_params: to_canonical must yield the LOGICAL-order chunk
     tuple (identical to the replicated engine's init from the same key),
     from_canonical must invert it, and a canonical checkpoint written by
@@ -702,6 +720,9 @@ def test_1f1b_activation_stash_is_o_s():
 
 @pytest.mark.slow
 def test_1f1b_activation_stash_is_o_s_m8():
+    """Tier-1 twins: test_1f1b_activation_stash_is_o_s (S=2,M=4
+    structural case) and test_ring_depth_is_independent_of_microbatch_
+    count (the table-level sweep)."""
     _assert_stash_o_s(S=4, M=8)
 
 
@@ -718,8 +739,11 @@ def test_ring_depth_is_independent_of_microbatch_count():
 
 @pytest.mark.slow
 def test_lm_pipeline_1f1b_matches_gpipe():
-    """The LM-only 1f1b code paths — integer stage-0 input (its vjp
-    cotangent is skipped), token-level (mb*T, vocab) head rows, and the
+    """Tier-1 twin: test_transformer_pipeline.py's LM pipeline rows
+    (gpipe engine + dryrun lm_pipeline leg) keep the LM head wiring in
+    the default run. The LM-only 1f1b code paths — integer stage-0
+    input (its vjp cotangent is skipped), token-level (mb*T, vocab)
+    head rows, and the
     per-microbatch label slice of the pre-flattened targets — pinned by
     a 2-step trajectory comparison against gpipe, with dropout active so
     the (stage, microbatch) key discipline is exercised too."""
@@ -765,7 +789,9 @@ def test_lm_pipeline_1f1b_matches_gpipe():
 
 @pytest.mark.slow
 def test_lm_pipeline_interleaved_matches_gpipe():
-    """LM-head code paths under the interleaved schedule — integer
+    """Tier-1 twin: test_cli.py::test_model_parallel_cli_interleaved +
+    the lm dryrun legs keep interleaved wiring in the default run.
+    LM-head code paths under the interleaved schedule — integer
     chunk-0 input, token-level (mb*T, vocab) rows on the LAST logical
     chunk, per-microbatch label slices — pinned by a 2-step trajectory
     against a gpipe engine running the same 4 chunks as 4 physical

@@ -45,7 +45,12 @@ def _params_close(a, b, engine_a=None, engine_b=None):
         )
 
 
-@pytest.mark.parametrize("engine_cls", [DataParallelEngine, DDPEngine])
+# The declarative-DP case rides slow (tier-1 budget): the DDPEngine
+# case keeps the flat-engine remat parity in tier-1 on the same model.
+@pytest.mark.parametrize(
+    "engine_cls",
+    [pytest.param(DataParallelEngine, marks=pytest.mark.slow), DDPEngine],
+)
 def test_dp_remat_matches(engine_cls):
     """Per-block remat lives at model construction for the flat engines
     (a whole-model checkpoint would save no peak HBM)."""
@@ -58,7 +63,12 @@ def test_dp_remat_matches(engine_cls):
     _params_close(ts_a, ts_b)
 
 
+@pytest.mark.slow
 def test_pipeline_remat_matches():
+    """remat=True does not change pipeline math. `slow` (tier-1
+    budget); tier-1 twin: test_pipeline_schedule.py::
+    test_1f1b_remat_parity pins pipeline-x-remat parity (vs gpipe AND
+    dense) on the same stage anatomy."""
     mesh = make_mesh(MeshSpec(data=2, stage=4))
     stages = tinycnn.split_stages(4, 10)
     plain = PipelineEngine(

@@ -72,9 +72,13 @@ def test_bert_pipeline_matches_dense(pp_mesh):
     assert float(m["count"]) == 8
 
 
+@pytest.mark.slow
 def test_bert_pipeline_trains_on_text_task(pp_mesh):
     """End-to-end: BERT pipeline (GPipe M=2) learns the synthetic
-    text-classification task — loss falls over a few steps."""
+    text-classification task — loss falls over a few steps. `slow`
+    (tier-1 budget); tier-1 twin: test_bert_pipeline_matches_dense
+    (the same stage split pinned against the dense model, a strictly
+    stronger assertion than a falling loss)."""
     ds = synthetic_text(64, T, 4, vocab_size=BERT_CFG.vocab_size, seed=1)
     stages = bert.split_stages(4, 4, BERT_CFG)
     eng = PipelineEngine(
@@ -123,7 +127,12 @@ def test_gpt_pipeline_matches_dense_lm(pp_mesh):
     assert float(m["count"]) == ids.shape[0] * (T - 1)
 
 
+@pytest.mark.slow
 def test_gpt_pipeline_trains(pp_mesh):
+    """GPT pipeline convergence smoke. `slow` (tier-1 budget); tier-1
+    twin: test_gpt_pipeline_matches_dense_lm (same stage split pinned
+    against the dense LM loss, strictly stronger than a falling
+    loss)."""
     stages = gpt.split_stages(4, GPT_CFG)
     eng = PipelineEngine(
         stages, SGD(momentum=0.9), pp_mesh, num_microbatches=2,
@@ -206,10 +215,14 @@ def test_pipeline_engine_multi_step_dispatch(pp_mesh, tmp_path):
     assert h["count"] == 64 and np.isfinite(h["loss"])
 
 
+@pytest.mark.slow
 def test_sp_engine_multi_step_dispatch():
     """compile_multi_step over the sequence-parallel engine (the LM
     CLI's --steps-per-dispatch engine path): ring ppermutes must trace
-    inside the scan body."""
+    inside the scan body. `slow` (tier-1 budget); tier-1 twins:
+    test_trainer.py::test_multi_step_dispatch_with_shard_map_engine
+    (scan-wrapped shard_map dispatch) and tests/test_multistep.py's
+    k=1/k=2 parity rows."""
     from distributed_model_parallel_tpu.parallel.sequence_parallel import (
         CausalLMSequenceParallelEngine,
     )

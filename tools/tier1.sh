@@ -23,7 +23,10 @@
 #     pytest's summary line),
 #   * a per-module slowest-10 durations digest (from pytest's
 #     --durations section) so a module creeping toward the 870 s budget
-#     is visible in every run, not just the ones that blow it,
+#     is visible in every run, not just the ones that blow it, with an
+#     explicit WARNING line for any module whose >=0.5s tests total
+#     more than 120 s (the budget-rebalance trigger: such a module is
+#     the next candidate for a slow demotion with a tier-1 twin),
 #   * exits with pytest's status (PIPESTATUS survives the tee).
 
 set -o pipefail
@@ -81,6 +84,10 @@ for mod in sorted(rows, key=lambda k: -sum(s for s, _ in rows[k])):
     print(f"[tier1-durations] {mod} ({total:.1f}s in >=0.5s tests) "
           f"slowest-{len(top)}: "
           + ", ".join(f"{name}={secs:.1f}s" for secs, name in top))
+    if total > 120:
+        print(f"[tier1-durations] WARNING: {mod} exceeds 120s "
+              f"({total:.1f}s) — candidate for a slow demotion with a "
+              f"tier-1 twin (budget-rebalance convention)")
 PYEOF
 
 exit $rc
