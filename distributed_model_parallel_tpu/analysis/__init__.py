@@ -1,0 +1,31 @@
+"""Static analysis over lowered/compiled HLO — the collective-contract
+linter.
+
+PRs 2-5 earned their perf claims structurally: HLO pins asserting ring
+shapes (S-1 permutes per collective-matmul ring, 2(S-1) per bucket),
+fabric routing (no grad-sized all-reduce over 'dcn'), and overlap
+dependency freedom (first-fired bucket independent of stage-0 backward).
+That machinery lived as private helpers inside individual test files and
+covered only the combos someone hand-wrote a pin for. This package
+promotes it to a first-class subsystem:
+
+  hlo.py          text -> instruction-graph model (computations,
+                  instructions, operands, called computations,
+                  named-scope tags, replica groups, shapes/dtypes/bytes,
+                  conservative transitive reachability)
+  collectives.py  classify every collective: kind, payload bytes,
+                  ring-vs-monolithic, and which mesh fabric it crosses
+                  ('ici' vs 'dcn') by mapping replica groups back
+                  through the mesh device array
+  rules.py        declarative registry of severity-tagged rules encoding
+                  the contracts the repo claims in prose (INTERNALS §8b
+                  catalogs them)
+  lint.py         lower any engine x model x mode combo on a virtual
+                  mesh and run the registry over it; `tools/hlolint` is
+                  the CLI
+
+The tests (tests/test_collectives_hlo.py and friends) import this
+library instead of carrying private parsers; tests/test_hlolint.py lints
+the engine matrix so a future engine change that breaks a contract fails
+with a named rule, not a silent perf regression.
+"""

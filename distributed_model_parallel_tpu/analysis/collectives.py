@@ -1,0 +1,210 @@
+"""Classify every collective in a parsed HLO module against the mesh.
+
+The piece the test-local pins never had: each collective op is mapped
+back through the mesh's device array to the AXES it actually crosses,
+so a rule can say "no grad-sized all-reduce over 'dcn'" instead of
+counting ops and hoping. Group/pair ids in compiled HLO are global
+device ids when `use_global_device_ids=true` (every lowering this repo
+produces); the mesh model resolves an id to its mesh coordinates and a
+collective's crossed axes are the axes on which any group's (or
+permute pair's) members differ.
+
+Ring-vs-monolithic is structural: `collective-permute` hops are ring
+traffic (the chunked `ppermute` decompositions of
+`ops/collective_matmul.py` / `ops/grad_reduction.py`);
+all-gather / reduce-scatter / all-reduce / all-to-all are the
+monolithic fused forms the rings exist to replace.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, FrozenSet, List, Optional, Sequence, Tuple
+
+from distributed_model_parallel_tpu.analysis.hlo import (
+    HloModule,
+    Instruction,
+)
+
+
+@dataclasses.dataclass(frozen=True)
+class MeshModel:
+    """The linter's view of a device mesh: axis names/sizes and the
+    device-id -> coordinates map. Built from a `jax.sharding.Mesh` via
+    `from_mesh` (the only jax-touching entry point) or directly from a
+    coordinate table (golden tests)."""
+
+    axis_names: Tuple[str, ...]
+    shape: Tuple[int, ...]
+    coords: Dict[int, Tuple[int, ...]]  # device id -> mesh coordinates
+
+    @classmethod
+    def from_mesh(cls, mesh) -> "MeshModel":
+        coords = {}
+        import numpy as np
+
+        for idx, dev in np.ndenumerate(mesh.devices):
+            coords[int(dev.id)] = tuple(int(i) for i in idx)
+        return cls(
+            axis_names=tuple(mesh.axis_names),
+            shape=tuple(int(mesh.shape[a]) for a in mesh.axis_names),
+            coords=coords,
+        )
+
+    def size(self, axis: str) -> int:
+        return self.shape[self.axis_names.index(axis)]
+
+    def axes_of_ids(self, ids: Sequence[int]) -> Optional[FrozenSet[str]]:
+        """Axes on which the given device ids differ — the fabric a
+        group of that membership crosses. None when an id is unknown
+        (the conservative 'cannot classify' answer)."""
+        cs = []
+        for i in ids:
+            c = self.coords.get(int(i))
+            if c is None:
+                return None
+            cs.append(c)
+        crossed = set()
+        first = cs[0]
+        for c in cs[1:]:
+            for d, (a, b) in enumerate(zip(first, c)):
+                if a != b:
+                    crossed.add(self.axis_names[d])
+        return frozenset(crossed)
+
+
+@dataclasses.dataclass(frozen=True)
+class ClassifiedCollective:
+    """One collective op with its contract-relevant facts attached."""
+
+    instruction: Instruction
+    kind: str  # base op: all-reduce / collective-permute / ...
+    axes: Optional[FrozenSet[str]]  # mesh axes crossed; None = unknown
+    payload_bytes: int
+    is_ring_hop: bool  # collective-permute (chunked-ring traffic)
+
+    @property
+    def name(self) -> str:
+        return self.instruction.name
+
+    @property
+    def is_scalar(self) -> bool:
+        return self.instruction.is_scalar
+
+    @property
+    def dtypes(self) -> Tuple[str, ...]:
+        return tuple(b.dtype for b in self.instruction.buffers)
+
+    @property
+    def shapes(self) -> Tuple[Tuple[int, ...], ...]:
+        return tuple(b.shape for b in self.instruction.buffers)
+
+    def crosses(self, axis: Optional[str]) -> bool:
+        """True when this collective's membership spans `axis`. Unknown
+        membership (axes=None) conservatively answers True — a rule
+        forbidding traffic on a fabric must not be dodged by an
+        unparseable group list."""
+        if axis is None:
+            return False
+        if self.axes is None:
+            return True
+        return axis in self.axes
+
+
+def classify_instruction(
+    instr: Instruction, mesh: MeshModel
+) -> ClassifiedCollective:
+    base = instr.base_op
+    axes: Optional[FrozenSet[str]] = None
+    if instr.source_target_pairs is not None:
+        crossed: set = set()
+        ok = True
+        for s, t in instr.source_target_pairs:
+            a = mesh.axes_of_ids((s, t))
+            if a is None:
+                ok = False
+                break
+            crossed |= a
+        axes = frozenset(crossed) if ok else None
+    elif instr.replica_groups == ():
+        # Empty replica_groups is XLA's printed form for ONE group of
+        # ALL devices — a world-spanning collective. Classifying it as
+        # crossing nothing would hide exactly the traffic the fabric
+        # rules forbid, so it spans every non-trivial mesh axis.
+        axes = frozenset(
+            a for a, s in zip(mesh.axis_names, mesh.shape) if s > 1
+        )
+    elif instr.replica_groups is not None:
+        crossed = set()
+        ok = True
+        for g in instr.replica_groups:
+            if len(g) < 2:
+                continue
+            a = mesh.axes_of_ids(g)
+            if a is None:
+                ok = False
+                break
+            crossed |= a
+        axes = frozenset(crossed) if ok else None
+    # Payload: the async tuple form carries context buffers alongside
+    # the data; count only real array buffers (all of them — context
+    # u32/token buffers are tiny and harmless to include).
+    payload = instr.nbytes
+    return ClassifiedCollective(
+        instruction=instr,
+        kind=base,
+        axes=axes,
+        payload_bytes=payload,
+        is_ring_hop=(base == "collective-permute"),
+    )
+
+
+def classify(module: HloModule, mesh: MeshModel
+             ) -> List[ClassifiedCollective]:
+    """Every collective in the module, classified. Async `-start`/`-done`
+    pairs are counted once (on the start)."""
+    return [classify_instruction(i, mesh) for i in module.collectives()]
+
+
+def ring_permutes_over(
+    collectives: Sequence[ClassifiedCollective], axis: str
+) -> List[ClassifiedCollective]:
+    """The ring traffic on one fabric: collective-permutes whose pairs
+    stay WITHIN `axis` (axes == {axis}) — a permute that also crosses
+    another axis belongs to a different wire (e.g. the pipeline's
+    stage hops)."""
+    return [
+        c for c in collectives
+        if c.is_ring_hop and c.axes is not None and c.axes == {axis}
+    ]
+
+
+def monolithic_over(
+    collectives: Sequence[ClassifiedCollective], axis: str,
+    kinds: Tuple[str, ...] = ("all-gather", "reduce-scatter"),
+) -> List[ClassifiedCollective]:
+    """Monolithic (fused) collectives of the given kinds crossing
+    `axis` — what a latency-hiding ring must have replaced."""
+    return [
+        c for c in collectives if c.kind in kinds and c.crosses(axis)
+    ]
+
+
+def nonscalar_all_reduces(
+    collectives: Sequence[ClassifiedCollective],
+) -> List[ClassifiedCollective]:
+    return [
+        c for c in collectives
+        if c.kind == "all-reduce" and not c.is_scalar
+    ]
+
+
+__all__ = [
+    "ClassifiedCollective",
+    "MeshModel",
+    "classify",
+    "classify_instruction",
+    "monolithic_over",
+    "nonscalar_all_reduces",
+    "ring_permutes_over",
+]

@@ -1,0 +1,405 @@
+"""Instruction-graph model of compiled-HLO text.
+
+Promoted and hardened from the private `_hlo_graph`/`_depends_on`
+helpers that lived in tests/test_collectives_hlo.py (PR 5). The parser
+is deliberately text-level — `lowered.compile().as_text()` is the one
+artifact every backend produces and the same surface the HLO pins have
+always matched against — and deliberately CONSERVATIVE: instruction
+references include operands AND called computations (fusion bodies,
+reduction regions, to_apply targets), so reachability over the graph is
+an over-approximation of data dependence. That is the safe direction
+for every rule that asserts the ABSENCE of a dependency (the overlap
+pins): a false edge can only make such a rule harder to pass, never
+let a real serialization slip through.
+
+No jax import here: the module parses strings, so golden-file tests and
+the rule registry stay importable without a backend.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+import re
+from typing import Dict, Iterable, List, Optional, Sequence, Tuple
+
+# Bytes per element for the HLO primitive types that can appear in a
+# result shape. Token/opaque carry no payload.
+DTYPE_BYTES = {
+    "pred": 1, "s2": 1, "u2": 1, "s4": 1, "u4": 1,
+    "s8": 1, "u8": 1, "f8e4m3fn": 1, "f8e5m2": 1, "f8e4m3": 1,
+    "f8e3m4": 1, "f8e4m3b11fnuz": 1, "f8e5m2fnuz": 1, "f8e4m3fnuz": 1,
+    "s16": 2, "u16": 2, "f16": 2, "bf16": 2,
+    "s32": 4, "u32": 4, "f32": 4,
+    "s64": 8, "u64": 8, "f64": 8, "c64": 8,
+    "c128": 16,
+    "token": 0, "opaque": 0,
+}
+
+# A collective op's result type in HLO text: a plain shape token on sync
+# backends (`= f32[8,16]{1,0} all-gather(`) or a parenthesized tuple on
+# async ones (`= (f32[...], f32[...]) all-gather-start(`).
+RESULT_RE = r"(?:\([^)\n]*\)|\S+)"
+
+COLLECTIVE_OPS = (
+    "collective-permute",
+    "all-gather",
+    "reduce-scatter",
+    "all-reduce",
+    "all-to-all",
+)
+
+_SHAPE_RE = re.compile(r"([a-z][a-z0-9]*)\[([0-9,]*)\]")
+_INSTR_RE = re.compile(
+    rf"(?:ROOT\s+)?%([\w.\-]+)\s*=\s*({RESULT_RE})\s+([\w\-]+)\(")
+_COMP_RE = re.compile(r"(?:ENTRY\s+)?%?([\w.\-]+)\s*\(")
+_OPNAME_RE = re.compile(r'op_name="([^"]*)"')
+_CHANNEL_RE = re.compile(r"channel_id=(\d+)")
+# replica_groups, both printed forms: explicit `{{0,1},{2,3}}` (ends at
+# the first `}}` — group bodies never nest) or empty `{}`, or the iota
+# (v2) form `[4,2]<=[2,4]T(1,0)`.
+_GROUPS_RE = re.compile(
+    r"replica_groups=(\{\{.*?\}\}|\{\}|"
+    r"\[[0-9,]*\]<=\[[0-9,]*\](?:T\([0-9,]*\))?)"
+)
+
+
+@dataclasses.dataclass(frozen=True)
+class Buffer:
+    """One result buffer of an instruction: dtype token + static shape."""
+
+    dtype: str
+    shape: Tuple[int, ...]
+
+    @property
+    def elems(self) -> int:
+        return int(math.prod(self.shape)) if self.shape else 1
+
+    @property
+    def nbytes(self) -> int:
+        return self.elems * DTYPE_BYTES.get(self.dtype, 4)
+
+    @property
+    def is_scalar(self) -> bool:
+        return not self.shape
+
+
+def parse_result_buffers(result: str) -> Tuple[Buffer, ...]:
+    """Buffers carried by an instruction's printed result type —
+    `f32[2,4]{1,0}`, `pred[]`, or an async tuple
+    `(f32[2,4]{1,0}, u32[], ...)`. Layout annotations and index
+    comments are ignored."""
+    out = []
+    for dt, dims in _SHAPE_RE.findall(result):
+        if dt not in DTYPE_BYTES:
+            continue  # a stray word that merely looks shape-like
+        shape = tuple(int(d) for d in dims.split(",")) if dims else ()
+        out.append(Buffer(dt, shape))
+    return tuple(out)
+
+
+def parse_replica_groups(attr: str) -> Optional[Tuple[Tuple[int, ...], ...]]:
+    """Replica groups from either printed form:
+
+    * explicit lists — `{{0,1,2,3},{4,5,6,7}}` (or the empty `{}`),
+    * iota (v2) — `[2,4]<=[8]` or `[4,2]<=[2,4]T(1,0)`: reshape
+      arange(prod(dims)) to `dims`, transpose by the permutation,
+      flatten, reshape to the group shape.
+
+    Returns a tuple of id tuples, or None when the attribute is absent.
+    """
+    attr = attr.strip()
+    if attr.startswith("{"):
+        inner = attr[1:-1].strip()
+        if not inner:
+            return ()
+        groups = re.findall(r"\{([0-9,\s]*)\}", attr)
+        return tuple(
+            tuple(int(x) for x in g.replace(" ", "").split(",") if x != "")
+            for g in groups
+        )
+    m = re.match(
+        r"\[([0-9,]*)\]<=\[([0-9,]*)\](?:T\(([0-9,]*)\))?", attr
+    )
+    if not m:
+        return None
+    gshape = [int(x) for x in m.group(1).split(",") if x]
+    dims = [int(x) for x in m.group(2).split(",") if x]
+    perm = (
+        [int(x) for x in m.group(3).split(",") if x]
+        if m.group(3) is not None else list(range(len(dims)))
+    )
+    n = int(math.prod(dims)) if dims else 0
+    ids = list(range(n))
+    # reshape->transpose->flatten without numpy: walk the transposed
+    # index space and read through the original row-major strides.
+    if dims and perm != list(range(len(dims))):
+        strides = [0] * len(dims)
+        acc = 1
+        for i in reversed(range(len(dims))):
+            strides[i] = acc
+            acc *= dims[i]
+        tdims = [dims[p] for p in perm]
+        tstrides = [strides[p] for p in perm]
+        flat = []
+        idx = [0] * len(tdims)
+        for _ in range(n):
+            flat.append(sum(i * s for i, s in zip(idx, tstrides)))
+            for d in reversed(range(len(tdims))):
+                idx[d] += 1
+                if idx[d] < tdims[d]:
+                    break
+                idx[d] = 0
+        ids = flat
+    if not gshape:
+        return (tuple(ids),) if ids else ()
+    per = gshape[-1]
+    n_groups = int(math.prod(gshape[:-1]))
+    return tuple(
+        tuple(ids[g * per:(g + 1) * per]) for g in range(n_groups)
+    )
+
+
+def _parse_pairs(line: str) -> Optional[Tuple[Tuple[int, int], ...]]:
+    m = re.search(r"source_target_pairs=\{\{(.*?)\}\}", line)
+    if not m:
+        return None
+    # "0,1},{1,2},..." — each {a,b} pair yields one digit,digit match;
+    # the "},{"" separators keep pairs from matching across groups.
+    pairs = re.findall(r"(\d+)\s*,\s*(\d+)", m.group(1))
+    return tuple((int(a), int(b)) for a, b in pairs)
+
+
+@dataclasses.dataclass(frozen=True)
+class Instruction:
+    """One HLO instruction, as printed."""
+
+    name: str
+    op: str  # op token, including any -start/-done suffix
+    buffers: Tuple[Buffer, ...]
+    refs: frozenset  # every %name referenced on the line (operands +
+    #                  called computations) — the conservative edge set
+    op_name: str  # metadata op_name (named-scope path), "" if absent
+    computation: str  # owning computation's name
+    replica_groups: Optional[Tuple[Tuple[int, ...], ...]] = None
+    source_target_pairs: Optional[Tuple[Tuple[int, int], ...]] = None
+    channel_id: Optional[int] = None
+    is_root: bool = False
+    parameter_number: Optional[int] = None
+
+    @property
+    def base_op(self) -> str:
+        """Op with the async `-start` suffix stripped (a `-done` keeps
+        its suffix: the pair is counted once, on the start)."""
+        return self.op[:-6] if self.op.endswith("-start") else self.op
+
+    @property
+    def nbytes(self) -> int:
+        return sum(b.nbytes for b in self.buffers)
+
+    @property
+    def is_scalar(self) -> bool:
+        """True when every result buffer is rank-0 (the metrics-psum
+        shape every engine legitimately keeps). An instruction whose
+        result failed the shape grammar (empty buffers) answers False —
+        an unparseable collective must stay VISIBLE to the non-scalar
+        rules, not vanish into the scalar allowance."""
+        return bool(self.buffers) and all(b.is_scalar for b in self.buffers)
+
+
+@dataclasses.dataclass
+class HloModule:
+    """Parsed module: computations (name -> ordered instruction names),
+    instructions (name -> Instruction), entry computation name, and the
+    input_output_alias table from the HloModule header line."""
+
+    computations: Dict[str, List[str]]
+    instructions: Dict[str, Instruction]
+    entry: Optional[str]
+    input_output_aliases: int  # number of aliased output indices
+    text: str
+
+    # ---------------------------------------------------- reachability
+
+    def depends_on(self, start: str, targets: Iterable[str]) -> bool:
+        """True when `start` transitively references any name in
+        `targets`, through operands and called computations — the
+        conservative over-approximation of data dependence (module
+        docstring)."""
+        targets = set(targets)
+        seen, stack = set(), [start]
+        while stack:
+            n = stack.pop()
+            if n in seen:
+                continue
+            seen.add(n)
+            if n in targets and n != start:
+                return True
+            instr = self.instructions.get(n)
+            refs = instr.refs if instr is not None else ()
+            for r in refs:
+                if r in self.computations:
+                    stack.extend(self.computations[r])
+                elif r in self.instructions:
+                    stack.append(r)
+        return False
+
+    # --------------------------------------------------------- queries
+
+    def tagged(self, tag: str, op_prefix: Optional[str] = None
+               ) -> List[str]:
+        """Instruction names whose op_name metadata carries `tag` (a
+        named-scope segment, matched with its trailing '/' so stage1
+        never matches stage10), optionally filtered by op prefix."""
+        return [
+            n for n, i in self.instructions.items()
+            if f"{tag}/" in i.op_name
+            and (op_prefix is None or i.op.startswith(op_prefix))
+        ]
+
+    def collectives(self) -> List[Instruction]:
+        """Every collective instruction, async pairs counted once (the
+        `-start` form carries the attributes; `-done` is skipped)."""
+        out = []
+        for i in self.instructions.values():
+            base = i.op[:-6] if i.op.endswith("-start") else i.op
+            if base in COLLECTIVE_OPS and not i.op.endswith("-done"):
+                out.append(i)
+        return out
+
+    def entry_parameters(self) -> List[Instruction]:
+        """The entry computation's `parameter` instructions — the
+        per-device at-rest buffers of the compiled step (what the FSDP
+        at-rest rule sizes)."""
+        if self.entry is None:
+            return []
+        return [
+            self.instructions[n]
+            for n in self.computations.get(self.entry, [])
+            if n in self.instructions
+            and self.instructions[n].op == "parameter"
+        ]
+
+
+def parse_hlo(text: str) -> HloModule:
+    """Parse compiled-HLO text into an `HloModule`.
+
+    Tolerant by construction: unknown attributes are ignored, an
+    instruction that fails the shape grammar still lands in the graph
+    with empty buffers, and metadata-free lines get an empty op_name —
+    parsing must never be the reason a lint run dies (missing pieces
+    surface as rule findings instead)."""
+    comps: Dict[str, List[str]] = {}
+    instrs: Dict[str, Instruction] = {}
+    entry = None
+    current = None
+    aliases = 0
+    for raw in text.splitlines():
+        s = raw.strip()
+        if not s:
+            continue
+        if s.startswith("HloModule"):
+            if "input_output_alias=" in s:
+                # one `}: (` per alias entry: `{0}: (0, {}, may-alias)`
+                aliases = len(re.findall(r"\}\s*:\s*\(", s))
+            continue
+        if s.endswith("{") and "= " not in s:
+            m = _COMP_RE.match(s)
+            if m:
+                current = m.group(1)
+                comps[current] = []
+                if s.startswith("ENTRY"):
+                    entry = current
+                continue
+        if s == "}":
+            current = None
+            continue
+        m = _INSTR_RE.match(s)
+        if m and current is not None:
+            name, result, op = m.groups()
+            meta = _OPNAME_RE.search(s)
+            chan = _CHANNEL_RE.search(s)
+            gm = _GROUPS_RE.search(s)
+            groups = parse_replica_groups(gm.group(1)) if gm else None
+            pairs = _parse_pairs(s)
+            pnum = None
+            if op == "parameter":
+                pm = re.search(r"parameter\((\d+)\)", s)
+                pnum = int(pm.group(1)) if pm else None
+            refs = frozenset(re.findall(r"%([\w.\-]+)", s)) - {name}
+            instrs[name] = Instruction(
+                name=name,
+                op=op,
+                buffers=parse_result_buffers(result),
+                refs=refs,
+                op_name=meta.group(1) if meta else "",
+                computation=current,
+                replica_groups=groups,
+                source_target_pairs=pairs,
+                channel_id=int(chan.group(1)) if chan else None,
+                is_root=s.startswith("ROOT"),
+                parameter_number=pnum,
+            )
+            comps[current].append(name)
+    return HloModule(
+        computations=comps,
+        instructions=instrs,
+        entry=entry,
+        input_output_aliases=aliases,
+        text=text,
+    )
+
+
+# ------------------------------------------------- text-level helpers
+# The original test-file pins matched raw text; these keep that exact
+# behavior available (and the refactored tests byte-compatible) without
+# a full parse.
+
+
+def collective_counts(hlo: str) -> Dict[str, int]:
+    """Occurrences of each collective OP (not operand mentions) in
+    compiled HLO text; async backends emit `<op>-start`/`-done` pairs,
+    counted once via the -start form."""
+
+    def n(op):
+        return len(re.findall(rf"= {RESULT_RE} {op}(?:-start)?\(", hlo))
+
+    return {op: n(op) for op in COLLECTIVE_OPS}
+
+
+def has_op_with_result(hlo: str, op: str, shape: str) -> bool:
+    """True when an `op` whose RESULT carries `shape` exists — matched
+    on the op's definition line (sync or async-start form), never on
+    operand mentions."""
+    pat = (
+        rf"= (?:\([^)\n]*{re.escape(shape)}[^)\n]*\)|{re.escape(shape)}"
+        rf"\S*) {op}(?:-start)?\("
+    )
+    return re.search(pat, hlo) is not None
+
+
+def nonscalar_all_reduce_count(hlo: str) -> int:
+    """all-reduce ops whose RESULT carries at least one non-scalar
+    buffer — gradient-sized reductions, as opposed to the scalar
+    metrics psums every engine legitimately keeps."""
+    n = 0
+    for m in re.finditer(rf"= ({RESULT_RE}) all-reduce(?:-start)?\(", hlo):
+        if re.search(r"\[\d", m.group(1)):
+            n += 1
+    return n
+
+
+__all__ = [
+    "Buffer",
+    "COLLECTIVE_OPS",
+    "DTYPE_BYTES",
+    "HloModule",
+    "Instruction",
+    "RESULT_RE",
+    "collective_counts",
+    "has_op_with_result",
+    "nonscalar_all_reduce_count",
+    "parse_hlo",
+    "parse_replica_groups",
+    "parse_result_buffers",
+]

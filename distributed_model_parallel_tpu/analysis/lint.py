@@ -1,0 +1,811 @@
+"""Lint driver: lower engine x model x mode combos on a virtual mesh
+and run the rule registry over the compiled HLO.
+
+`tools/hlolint` is the CLI; tests/test_hlolint.py runs a tier-1 subset
+plus the full matrix (slow). Per-combo results stream as the
+established partial-JSON convention (`{"leg": ..., "partial": true}`
+lines, one per finished combo), so a wedged or killed run still shows
+exactly which combos were judged; the final summary is one JSON object
+with the violation count.
+
+Heavy imports (jax, engines) are function-local: the registry and
+parser stay importable without a backend, and the CLI can force the
+CPU platform before anything dials a device.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import sys
+from typing import Callable, List, Optional, Sequence, Tuple
+
+from distributed_model_parallel_tpu.analysis.collectives import MeshModel
+from distributed_model_parallel_tpu.analysis.rules import (
+    Finding,
+    LintContext,
+    LintTarget,
+    REGISTRY,
+    run_rules,
+)
+
+_DTYPE_TOKEN = {
+    "float32": "f32", "bfloat16": "bf16", "float16": "f16",
+    "float64": "f64",
+}
+
+
+@dataclasses.dataclass(frozen=True)
+class Combo:
+    """One cell of the engine x mode x mesh matrix. `size` is the
+    engine's PRIMARY parallel axis: the data axis for dp/ddp/fsdp/sp_lm,
+    'model' for tp and the cm_* op kernels, 'seq' for sp, 'stage' for
+    pipeline."""
+
+    engine: str
+    size: int
+    grad_reduction: str = "monolithic"
+    dcn: int = 1
+    collective_matmul: bool = False
+    bf16: bool = False
+    model: str = "mlp"  # mlp | tinycnn (ddp/fsdp families)
+
+    @property
+    def name(self) -> str:
+        bits = [self.engine, f"S{self.size}"]
+        if self.dcn > 1:
+            bits.append(f"dcn{self.dcn}")
+        if self.engine in ("ddp", "fsdp", "sp_lm"):
+            bits.append(self.grad_reduction)
+        if self.model != "mlp":
+            bits.append(self.model)
+        if self.collective_matmul:
+            bits.append("cm")
+        if self.bf16:
+            bits.append("bf16")
+        return "/".join(bits)
+
+
+@dataclasses.dataclass
+class LintReport:
+    combo: Combo
+    target: LintTarget
+    findings: List[Finding]
+    n_collectives: int
+
+    @property
+    def violations(self) -> List[Finding]:
+        return [f for f in self.findings if not f.exempted]
+
+    @property
+    def errors(self) -> List[Finding]:
+        return [f for f in self.violations if f.severity == "error"]
+
+
+# ------------------------------------------------------------ models
+
+
+def staged_mlp(n_blocks=8, width=32, classes=4):
+    """BN-free stem/blocks/head MLP: no model_state, so the only
+    data-fabric all-reduces an opted-in step may carry are the pinned
+    bucket hops — the model the reducer rules are sharpest on. Public:
+    tests/test_collectives_hlo.py pins against the SAME builder so the
+    lint matrix and the HLO pin tests can never desynchronize."""
+    from distributed_model_parallel_tpu.models import layers as L
+    from distributed_model_parallel_tpu.models import staging
+
+    stem = L.sequential(L.flatten(), L.linear(192, width), L.relu())
+    blocks = [
+        L.sequential(L.linear(width, width), L.relu())
+        for _ in range(n_blocks)
+    ]
+    return staging.staged_model(stem, blocks, L.linear(width, classes))
+
+
+def _bert_cfg(model_size: int):
+    from distributed_model_parallel_tpu.models.bert import BertConfig
+
+    return BertConfig(
+        vocab_size=64, hidden_size=32, num_layers=1,
+        num_heads=max(2, model_size), intermediate_size=64,
+        max_position=16, dropout_rate=0.0,
+    )
+
+
+def _gpt_cfg():
+    from distributed_model_parallel_tpu.models.gpt import GPTConfig
+
+    return GPTConfig(
+        vocab_size=61, dim=16, num_layers=4, num_heads=2, ffn_dim=32,
+        max_position=16, dropout_rate=0.0,
+    )
+
+
+def image_batch(n, hw=8, classes=4, seed=0):
+    """Deterministic fake image batch (shared with the pin tests)."""
+    import numpy as np
+
+    rng = np.random.RandomState(seed)
+    return (
+        rng.rand(n, hw, hw, 3).astype(np.float32),
+        rng.randint(0, classes, size=(n,)).astype(np.int32),
+    )
+
+
+# ------------------------------------------------------- expectations
+
+
+def _token(dtype) -> str:
+    import numpy as np
+
+    return _DTYPE_TOKEN.get(np.dtype(dtype).name, "f32")
+
+
+def _bucket_plan(leaves, bucket_mb: float, ici_size: int):
+    """[(padded_elems, dtype_token)] for one segment's gradient tree —
+    the shape the per-bucket collectives are pinned against."""
+    from distributed_model_parallel_tpu.ops.grad_reduction import (
+        plan_buckets,
+    )
+
+    out = []
+    for b in plan_buckets(leaves, bucket_mb):
+        padded = b.size + (-b.size % ici_size)
+        out.append((padded, _token(b.dtype)))
+    return tuple(out)
+
+
+def _reducer_plans(model, grad_reduction: str, bucket_mb: float,
+                   ici_size: int, overlap_auto: int = 4):
+    """Per-segment bucket plans + segment count for a staged model —
+    one segment for 'bucketed', split_points segments for
+    'overlapped'. Empty for 'monolithic'."""
+    import jax
+    import jax.numpy as jnp
+
+    from distributed_model_parallel_tpu.models import staging
+
+    key_aval = jax.ShapeDtypeStruct((2,), jnp.uint32)
+    p_aval, s_aval = jax.eval_shape(model.init, key_aval)
+    state_shapes = tuple(
+        tuple(leaf.shape)
+        for leaf in jax.tree_util.tree_leaves(s_aval)
+    )
+    if grad_reduction == "bucketed":
+        plans = (_bucket_plan(
+            jax.tree_util.tree_leaves(p_aval), bucket_mb, ici_size
+        ),)
+        return plans, 0, state_shapes
+    if grad_reduction == "overlapped":
+        n = staging.resolve_overlap_segments(
+            len(model.parts.blocks), 0, "lint"
+        )
+        cuts = staging.split_points(n, None, len(model.parts.blocks))
+        plans = tuple(
+            _bucket_plan(
+                jax.tree_util.tree_leaves(sp), bucket_mb, ici_size
+            )
+            for sp in staging.partition_tree(p_aval, cuts)
+        )
+        return plans, n, state_shapes
+    return (), 0, state_shapes
+
+
+def _n_param_leaves(ts) -> int:
+    import jax
+
+    return len(jax.tree_util.tree_leaves(ts.params)) + len(
+        jax.tree_util.tree_leaves(ts.opt_state)
+    )
+
+
+def jaxpr_ppermute_dtypes(fn, *args):
+    """((axis_names, dtype_token, scope), ...) for every `ppermute`
+    equation in fn's jaxpr, sub-jaxprs included — the trace-level dtype
+    record the bf16 ring rule reads (compiled CPU HLO normalizes bf16
+    collectives to f32, so dtypes must come from the trace). `scope` is
+    the equation's name_stack string (named_scope names survive jvp and
+    transpose, e.g. 'transpose(jvp(kv_ring))'), which is how the rule
+    distinguishes the deliberately-f32 KV ring from the cm rings."""
+    import jax
+
+    closed = jax.make_jaxpr(fn)(*args)
+    out = []
+    seen = set()
+
+    def walk(jaxpr):
+        if id(jaxpr) in seen:
+            return
+        seen.add(id(jaxpr))
+        for eqn in jaxpr.eqns:
+            if eqn.primitive.name == "ppermute":
+                axes = eqn.params.get("axis_name")
+                axes = axes if isinstance(axes, tuple) else (axes,)
+                dt = str(eqn.invars[0].aval.dtype)
+                out.append((
+                    tuple(str(a) for a in axes),
+                    _DTYPE_TOKEN.get(dt, dt),
+                    str(eqn.source_info.name_stack),
+                ))
+            for v in eqn.params.values():
+                for sub in _subjaxprs(v):
+                    walk(sub)
+
+    def _subjaxprs(v):
+        import jax.core as core
+
+        if isinstance(v, core.ClosedJaxpr):
+            yield v.jaxpr
+        elif isinstance(v, core.Jaxpr):
+            yield v
+        elif isinstance(v, (tuple, list)):
+            for x in v:
+                yield from _subjaxprs(x)
+
+    walk(closed.jaxpr)
+    return tuple(out)
+
+
+def _mesh_facts(mesh):
+    from distributed_model_parallel_tpu.runtime.mesh import (
+        data_hierarchy_axes,
+    )
+
+    d_axes, ici_axis, dcn_axis = data_hierarchy_axes(mesh)
+    return dict(
+        data_axes=tuple(d_axes),
+        ici_axis=ici_axis,
+        dcn_axis=dcn_axis,
+        ici_size=int(mesh.shape[ici_axis]),
+        dcn_size=int(mesh.shape[dcn_axis]) if dcn_axis else 1,
+    )
+
+
+# ----------------------------------------------------------- builders
+
+BUCKET_MB = 0.02  # small enough that every lint model splits >1 bucket
+
+
+def _build_data_engine(combo: Combo, devices):
+    """ddp / fsdp / dp over a data(-factored) mesh."""
+    import jax
+    import jax.numpy as jnp
+
+    from distributed_model_parallel_tpu.models.tinycnn import tiny_cnn
+    from distributed_model_parallel_tpu.runtime.mesh import (
+        MeshSpec, make_mesh,
+    )
+    from distributed_model_parallel_tpu.training.optim import SGD
+
+    s = combo.size
+    mesh = make_mesh(
+        MeshSpec(data=s, dcn=combo.dcn), devices=devices[:s]
+    )
+    facts = _mesh_facts(mesh)
+    if combo.model == "tinycnn":
+        model = tiny_cnn(4)
+    else:
+        model = staged_mlp(width=128 if combo.engine == "fsdp" else 32)
+    cdt = jnp.bfloat16 if combo.bf16 else None
+    kwargs = dict(donate=True, compute_dtype=cdt)
+    full_leaf_shapes: Tuple = ()
+    if combo.engine == "dp":
+        from distributed_model_parallel_tpu.parallel.data_parallel import (
+            DataParallelEngine,
+        )
+
+        eng = DataParallelEngine(model, SGD(), mesh, **kwargs)
+    elif combo.engine == "ddp":
+        from distributed_model_parallel_tpu.parallel.data_parallel import (
+            DDPEngine,
+        )
+
+        eng = DDPEngine(
+            model, SGD(), mesh, grad_reduction=combo.grad_reduction,
+            bucket_mb=BUCKET_MB, **kwargs,
+        )
+    else:  # fsdp
+        from distributed_model_parallel_tpu.parallel.fsdp import (
+            FSDPEngine, fsdp_specs,
+        )
+        from distributed_model_parallel_tpu.runtime.mesh import (
+            data_axis_names, data_axis_size,
+        )
+
+        min_elems = 64
+        eng = FSDPEngine(
+            model, SGD(), mesh, min_shard_elems=min_elems,
+            grad_reduction=combo.grad_reduction, bucket_mb=BUCKET_MB,
+            **kwargs,
+        )
+        from jax.sharding import PartitionSpec as P
+
+        key_aval = jax.ShapeDtypeStruct((2,), jnp.uint32)
+        p_aval, _ = jax.eval_shape(model.init, key_aval)
+        specs = fsdp_specs(
+            p_aval, data_axis_size(mesh), min_shard_elems=min_elems,
+            axes=data_axis_names(mesh),
+        )
+        is_spec = lambda x: isinstance(x, P)  # noqa: E731
+        shapes = []
+        for leaf, spec in zip(
+            jax.tree_util.tree_leaves(p_aval),
+            jax.tree_util.tree_leaves(specs, is_leaf=is_spec),
+        ):
+            if any(part is not None for part in spec):
+                shapes.append(tuple(leaf.shape))
+        full_leaf_shapes = tuple(shapes)
+
+    plans, n_seg, state_shapes = _reducer_plans(
+        model, combo.grad_reduction, BUCKET_MB, facts["ici_size"]
+    )
+    ts = eng.init_state(jax.random.PRNGKey(0))
+    im, lb = eng.shard_batch(*image_batch(16 * (s // 2 or 1)))
+    hlo = eng.train_step.lower(
+        ts, im, lb, jnp.float32(0.1)
+    ).compile().as_text()
+    target = LintTarget(
+        name=combo.name, engine=combo.engine,
+        grad_reduction=combo.grad_reduction, bf16=combo.bf16,
+        donate=True, bucket_plans=plans, overlap_segments=n_seg,
+        state_leaf_shapes=state_shapes,
+        fsdp_full_leaf_shapes=full_leaf_shapes,
+        n_param_leaves=_n_param_leaves(ts), **facts,
+    )
+    return target, hlo, mesh
+
+
+def _build_tp(combo: Combo, devices):
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from distributed_model_parallel_tpu.models.bert import (
+        bert_for_classification,
+    )
+    from distributed_model_parallel_tpu.parallel.tensor_parallel import (
+        TensorParallelEngine,
+    )
+    from distributed_model_parallel_tpu.runtime.mesh import (
+        MeshSpec, make_mesh,
+    )
+    from distributed_model_parallel_tpu.training.optim import SGD
+
+    s = combo.size
+    dp = 2 if 2 * s <= len(devices) else 1
+    mesh = make_mesh(
+        MeshSpec(data=dp, model=s), devices=devices[: dp * s]
+    )
+    cfg = _bert_cfg(s)
+    eng = TensorParallelEngine(
+        bert_for_classification(4, cfg), SGD(), mesh, donate=True,
+        collective_matmul=combo.collective_matmul,
+        compute_dtype=jnp.bfloat16 if combo.bf16 else None,
+    )
+    ts = eng.init_state(jax.random.PRNGKey(0))
+    rng = np.random.RandomState(0)
+    ids = rng.randint(1, 64, size=(4 * dp, 8)).astype(np.int32)
+    lb = rng.randint(0, 4, size=(4 * dp,)).astype(np.int32)
+    ids, lb = eng.shard_batch(ids, lb)
+    hlo = eng.train_step.lower(
+        ts, ids, lb, jnp.float32(0.1)
+    ).compile().as_text()
+    ring_dtypes = (
+        jaxpr_ppermute_dtypes(eng.train_step, ts, ids, lb,
+                              jnp.float32(0.1))
+        if combo.bf16 else ()
+    )
+    target = LintTarget(
+        name=combo.name, engine="tp", donate=True, bf16=combo.bf16,
+        ring_dtypes=ring_dtypes,
+        collective_matmul=combo.collective_matmul,
+        cm_axis="model" if combo.collective_matmul else None,
+        cm_size=s,
+        # 1 block = 4 opted-in projections; fwd 4(S-1) rings + the
+        # custom-vjp dual kernels >= 6(S-1) more (PR 2's engine pin).
+        cm_min_ring_permutes=10 * (s - 1),
+        n_param_leaves=_n_param_leaves(ts), **_mesh_facts(mesh),
+    )
+    return target, hlo, mesh
+
+
+def _build_sp(combo: Combo, devices):
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from distributed_model_parallel_tpu.parallel.sequence_parallel import (
+        SequenceParallelEngine,
+    )
+    from distributed_model_parallel_tpu.runtime.mesh import (
+        MeshSpec, make_mesh,
+    )
+    from distributed_model_parallel_tpu.training.optim import SGD
+
+    s = combo.size
+    dp = 2 if 2 * s <= len(devices) else 1
+    mesh = make_mesh(
+        MeshSpec(data=dp, seq=s), devices=devices[: dp * s]
+    )
+    cfg = _bert_cfg(4)
+    eng = SequenceParallelEngine(
+        cfg, 4, SGD(), mesh, donate=True,
+        collective_matmul=combo.collective_matmul,
+        compute_dtype=jnp.bfloat16 if combo.bf16 else None,
+    )
+    ts = eng.init_state(jax.random.PRNGKey(0))
+    rng = np.random.RandomState(0)
+    ids = rng.randint(1, 64, size=(4 * dp, 16)).astype(np.int32)
+    lb = rng.randint(0, 4, size=(4 * dp,)).astype(np.int32)
+    ids, lb = eng.shard_batch(ids, lb)
+    hlo = eng.train_step.lower(
+        ts, ids, lb, jnp.float32(0.1)
+    ).compile().as_text()
+    ring_dtypes = (
+        jaxpr_ppermute_dtypes(eng.train_step, ts, ids, lb,
+                              jnp.float32(0.1))
+        if combo.bf16 else ()
+    )
+    target = LintTarget(
+        name=combo.name, engine="sp", donate=True, bf16=combo.bf16,
+        ring_dtypes=ring_dtypes,
+        collective_matmul=combo.collective_matmul,
+        cm_axis="seq" if combo.collective_matmul else None,
+        cm_size=s,
+        # 1 block's FFN pair per step: fwd 2(S-1) rings + dual-kernel
+        # bwd 3(S-1) rings = 5(S-1) hops (PR 2's kernel accounting);
+        # the KV ring's hops ride the same axis, so this is a floor.
+        cm_min_ring_permutes=5 * (s - 1),
+        n_param_leaves=_n_param_leaves(ts), **_mesh_facts(mesh),
+    )
+    return target, hlo, mesh
+
+
+def _build_sp_lm(combo: Combo, devices):
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from distributed_model_parallel_tpu.models.gpt import gpt_lm
+    from distributed_model_parallel_tpu.parallel.sequence_parallel import (
+        CausalLMSequenceParallelEngine,
+    )
+    from distributed_model_parallel_tpu.runtime.mesh import (
+        MeshSpec, make_mesh,
+    )
+    from distributed_model_parallel_tpu.training.optim import SGD
+
+    s = combo.size  # the DATA axis (the bucket rings' fabric)
+    seq = 2
+    mesh = make_mesh(
+        MeshSpec(data=s, seq=seq, dcn=combo.dcn),
+        devices=devices[: s * seq],
+    )
+    facts = _mesh_facts(mesh)
+    cfg = _gpt_cfg()
+    eng = CausalLMSequenceParallelEngine(
+        cfg, SGD(), mesh, donate=True,
+        grad_reduction=combo.grad_reduction, bucket_mb=BUCKET_MB,
+        collective_matmul=combo.collective_matmul,
+    )
+    ts = eng.init_state(jax.random.PRNGKey(0))
+    rng = np.random.RandomState(0)
+    ids = rng.randint(1, 61, size=(4 * s, 16)).astype(np.int32)
+    ids, tg = eng.shard_batch(ids)
+    hlo = eng.train_step.lower(
+        ts, ids, tg, jnp.float32(0.1)
+    ).compile().as_text()
+
+    # Reducer expectations over the LM's stem/blocks/head params.
+    import jax as _jax
+
+    from distributed_model_parallel_tpu.models import staging
+
+    key_aval = _jax.ShapeDtypeStruct((2,), jnp.uint32)
+    p_aval, _ = _jax.eval_shape(gpt_lm(cfg).init, key_aval)
+    plans: Tuple = ()
+    n_seg = 0
+    if combo.grad_reduction == "bucketed":
+        plans = (_bucket_plan(
+            _jax.tree_util.tree_leaves(p_aval), BUCKET_MB,
+            facts["ici_size"],
+        ),)
+    elif combo.grad_reduction == "overlapped":
+        n_seg = staging.resolve_overlap_segments(
+            cfg.num_layers, 0, "lint"
+        )
+        cuts = staging.split_points(n_seg, None, cfg.num_layers)
+        plans = tuple(
+            _bucket_plan(
+                _jax.tree_util.tree_leaves(sp), BUCKET_MB,
+                facts["ici_size"],
+            )
+            for sp in staging.partition_tree(p_aval, cuts)
+        )
+    target = LintTarget(
+        name=combo.name, engine="sp_lm",
+        grad_reduction=combo.grad_reduction, donate=True,
+        collective_matmul=combo.collective_matmul,
+        cm_axis="seq" if combo.collective_matmul else None,
+        cm_size=seq,
+        cm_min_ring_permutes=5 * (seq - 1) * cfg.num_layers,
+        bucket_plans=plans, overlap_segments=n_seg,
+        n_param_leaves=_n_param_leaves(ts), **facts,
+    )
+    return target, hlo, mesh
+
+
+def _build_pipeline(combo: Combo, devices):
+    import jax
+    import jax.numpy as jnp
+
+    from distributed_model_parallel_tpu.models.tinycnn import split_stages
+    from distributed_model_parallel_tpu.parallel.pipeline import (
+        PipelineEngine,
+    )
+    from distributed_model_parallel_tpu.runtime.mesh import (
+        MeshSpec, make_mesh,
+    )
+    from distributed_model_parallel_tpu.training.optim import SGD
+
+    s = combo.size
+    dp = max(1, len(devices) // s)
+    mesh = make_mesh(
+        MeshSpec(data=dp, stage=s), devices=devices[: dp * s]
+    )
+    eng = PipelineEngine(
+        split_stages(s, 4), SGD(), mesh, num_microbatches=2,
+        donate=True,
+    )
+    ts = eng.init_state(jax.random.PRNGKey(0))
+    im, lb = eng.shard_batch(*image_batch(4 * dp))
+    hlo = eng.train_step.lower(
+        ts, im, lb, jnp.float32(0.1)
+    ).compile().as_text()
+    target = LintTarget(
+        name=combo.name, engine="pipeline", donate=True,
+        n_param_leaves=_n_param_leaves(ts), **_mesh_facts(mesh),
+    )
+    return target, hlo, mesh
+
+
+def _build_cm_op(combo: Combo, devices):
+    """Op-level kernel targets: the exact S-1 pin on ag_matmul /
+    matmul_rs, matching PR 2's kernel tests."""
+    from functools import partial
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.sharding import Mesh, PartitionSpec as P
+
+    from distributed_model_parallel_tpu.ops.collective_matmul import (
+        ag_matmul, matmul_rs,
+    )
+    from distributed_model_parallel_tpu.runtime.compat import shard_map
+
+    s = combo.size
+    mesh = Mesh(np.array(devices[:s]), ("model",))
+    dt = jnp.bfloat16 if combo.bf16 else jnp.float32
+    if combo.engine == "cm_ag":
+        x = jnp.zeros((2, 4 * s, 16), dt)
+        w = jnp.zeros((16, 8 * s), dt)
+        fn = jax.jit(shard_map(
+            partial(ag_matmul, axis_name="model"), mesh=mesh,
+            in_specs=(P(None, "model", None), P(None, "model")),
+            out_specs=P(None, None, "model"), check_vma=False,
+        ))
+    else:
+        x = jnp.zeros((2, 4 * s, 8 * s), dt)
+        w = jnp.zeros((8 * s, 16), dt)
+        fn = jax.jit(shard_map(
+            partial(matmul_rs, axis_name="model"), mesh=mesh,
+            in_specs=(P(None, None, "model"), P("model", None)),
+            out_specs=P(None, "model", None), check_vma=False,
+        ))
+    hlo = fn.lower(x, w).compile().as_text()
+    target = LintTarget(
+        name=combo.name, engine=combo.engine, bf16=combo.bf16,
+        data_axes=(), ici_axis=None, ici_size=1,
+        cm_axis="model", cm_size=s, expected_permutes=s - 1,
+    )
+    return target, hlo, mesh
+
+
+_BUILDERS: dict = {
+    "dp": _build_data_engine,
+    "ddp": _build_data_engine,
+    "fsdp": _build_data_engine,
+    "tp": _build_tp,
+    "sp": _build_sp,
+    "sp_lm": _build_sp_lm,
+    "pipeline": _build_pipeline,
+    "cm_ag": _build_cm_op,
+    "cm_rs": _build_cm_op,
+}
+
+
+def lint_combo(combo: Combo, devices=None) -> LintReport:
+    import jax
+
+    devices = list(devices if devices is not None else jax.devices())
+    target, hlo, mesh = _BUILDERS[combo.engine](combo, devices)
+    mesh_model = MeshModel.from_mesh(mesh)
+    ctx = LintContext.build(target, hlo, mesh_model)
+    return LintReport(
+        combo=combo,
+        target=target,
+        findings=run_rules(ctx),
+        n_collectives=len(ctx.collectives),
+    )
+
+
+# ------------------------------------------------------------ matrix
+
+
+def full_matrix() -> List[Combo]:
+    """The engine x mode matrix the acceptance criteria name: every
+    engine at S in {2,4,8} on its primary axis, DDP/FSDP/CausalLM-SP in
+    all three reduction modes, collective_matmul off/on, hybrid
+    2 x (S/2) dcn x ici meshes for the reducer paths, plus the bf16
+    ring combos and the tinycnn (BatchNorm) pre-gate twins."""
+    combos: List[Combo] = []
+    for s in (2, 4, 8):
+        combos += [Combo("cm_ag", s), Combo("cm_rs", s)]
+        combos.append(Combo("dp", s))
+        for gr in ("monolithic", "bucketed", "overlapped"):
+            combos.append(Combo("ddp", s, grad_reduction=gr))
+            combos.append(Combo("fsdp", s, grad_reduction=gr))
+        combos.append(Combo("tp", s))
+        combos.append(Combo("tp", s, collective_matmul=True))
+        combos.append(Combo("sp", s))
+        combos.append(Combo("sp", s, collective_matmul=True))
+    for s in (4, 8):  # hybrid 2 x (S/2) dcn x ici
+        for gr in ("bucketed", "overlapped"):
+            combos.append(Combo("ddp", s, grad_reduction=gr, dcn=2))
+            combos.append(Combo("fsdp", s, grad_reduction=gr, dcn=2))
+    for s in (2, 4):  # sp_lm: data axis x seq=2 (2S devices)
+        for gr in ("monolithic", "bucketed", "overlapped"):
+            combos.append(Combo("sp_lm", s, grad_reduction=gr))
+    combos.append(Combo("sp_lm", 4, grad_reduction="bucketed", dcn=2))
+    combos.append(Combo("sp_lm", 2, collective_matmul=True))
+    combos += [Combo("pipeline", 2), Combo("pipeline", 4)]
+    combos.append(Combo("tp", 4, collective_matmul=True, bf16=True))
+    combos.append(Combo("sp", 4, collective_matmul=True, bf16=True))
+    combos += pregate_matrix()
+    return combos
+
+
+def pregate_matrix() -> List[Combo]:
+    """The tier-1 pre-gate subset (tools/tier1.sh): tinycnn DDP + FSDP
+    overlapped — the deepest rule stack (rings + overlap deps + BN
+    allowlist + at-rest) for two lowerings' worth of compile time."""
+    return [
+        Combo("ddp", 8, grad_reduction="overlapped", model="tinycnn"),
+        Combo("fsdp", 8, grad_reduction="overlapped", model="tinycnn"),
+    ]
+
+
+# ------------------------------------------------------------ report
+
+
+def format_report(rep: LintReport) -> str:
+    lines = [
+        f"[hlolint] {rep.combo.name}: {rep.n_collectives} collectives, "
+        f"{len(rep.violations)} violation(s)"
+        + (f", {len(rep.findings) - len(rep.violations)} exempted"
+           if len(rep.findings) != len(rep.violations) else "")
+    ]
+    for f in rep.findings:
+        mark = "EXEMPT" if f.exempted else f.severity.upper()
+        lines.append(f"[hlolint]   {mark} {f.rule}: {f.message}"
+                     + (f" (exempt: {f.exemption_reason})"
+                        if f.exempted else ""))
+    return "\n".join(lines)
+
+
+def run(combos: Sequence[Combo], devices=None,
+        emit: Callable[[str], None] = print) -> dict:
+    """Lint each combo, streaming one partial-JSON line per finished
+    combo; returns (and emits) the final summary object."""
+    reports = []
+    for combo in combos:
+        try:
+            rep = lint_combo(combo, devices)
+        except Exception as e:  # a combo that fails to lower is a finding
+            emit(f"[hlolint] {combo.name}: LOWERING FAILED: {e!r}")
+            emit(json.dumps({
+                "leg": {"name": combo.name, "error": repr(e)},
+                "partial": True,
+            }))
+            reports.append(None)
+            continue
+        emit(format_report(rep))
+        emit(json.dumps({
+            "leg": {
+                "name": combo.name,
+                "violations": len(rep.violations),
+                "exempted": len(rep.findings) - len(rep.violations),
+                "collectives": rep.n_collectives,
+            },
+            "partial": True,
+        }))
+        reports.append(rep)
+    ok = [r for r in reports if r is not None]
+    summary = {
+        "hlo_lint": {
+            "targets": len(combos),
+            "lowered": len(ok),
+            "rules": len(REGISTRY),
+            "violations": sum(len(r.violations) for r in ok),
+            # A combo that fails to LOWER is an error too: an engine
+            # regression that crashes lowering must fail the gates, not
+            # slip past them with zero rule findings.
+            "errors": sum(len(r.errors) for r in ok)
+            + (len(combos) - len(ok)),
+            "exempted": sum(
+                len(r.findings) - len(r.violations) for r in ok
+            ),
+            "failed_targets": sorted(
+                {r.combo.name for r in ok if r.errors}
+                | {c.name for c, r in zip(combos, reports)
+                   if r is None}
+            ),
+        }
+    }
+    emit(json.dumps(summary))
+    return summary
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    import argparse
+
+    parser = argparse.ArgumentParser(
+        prog="hlolint",
+        description=(
+            "Static HLO collective-contract linter: lower engine x "
+            "mode combos on a virtual CPU mesh and check the rule "
+            "registry (INTERNALS.md section 8b)."
+        ),
+    )
+    parser.add_argument(
+        "--pregate", action="store_true",
+        help="tier-1 pre-gate subset (tinycnn DDP/FSDP overlapped)",
+    )
+    parser.add_argument(
+        "--filter", default=None,
+        help="regex over combo names (e.g. 'ddp.*dcn')",
+    )
+    parser.add_argument(
+        "--list-rules", action="store_true",
+        help="print the rule catalog and exit",
+    )
+    parser.add_argument("--devices", type=int, default=8)
+    args = parser.parse_args(argv)
+
+    if args.list_rules:
+        for r in REGISTRY.values():
+            print(f"{r.id:32s} {r.severity:5s} [{r.source}] "
+                  f"{r.contract}")
+        return 0
+
+    # Virtual CPU devices BEFORE any backend initializes (this
+    # environment preloads a TPU PJRT plugin that dials a relay).
+    from distributed_model_parallel_tpu.runtime.platform import force_cpu
+
+    force_cpu(args.devices)
+
+    combos = pregate_matrix() if args.pregate else full_matrix()
+    if args.filter:
+        import re
+
+        combos = [c for c in combos if re.search(args.filter, c.name)]
+    if not combos:
+        print("[hlolint] no combos match", file=sys.stderr)
+        return 2
+    summary = run(combos)
+    return 1 if summary["hlo_lint"]["errors"] else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,650 @@
+"""The collective-contract rule registry.
+
+Each rule encodes ONE contract the repo claims in prose (INTERNALS §3c/
+§3e/§3f/§5c, RESULTS §3b) as a check over a parsed+classified HLO
+module. Rules are severity-tagged and declare their own applicability
+over a `LintTarget` (the engine/mode/mesh description the lint driver
+fills in when it lowers a combo), so the same registry runs over the
+whole engine matrix and each combo is judged only against the contracts
+it opted into.
+
+Adding a rule (INTERNALS §8b has the walkthrough):
+
+    @rule(
+        id="my-rule", severity="error", source="PR N",
+        contract="one sentence of what must hold",
+        applies=lambda t: t.engine == "ddp",
+    )
+    def _my_rule(ctx: LintContext) -> list:
+        ...return [ctx.finding("my-rule", "what went wrong")]
+
+plus one positive (violation detected) and one negative (clean) test in
+tests/test_hlo_rules.py — the conftest meta-check fails collection when
+a registered rule is missing either polarity.
+
+Intended deviations are EXEMPTIONS, not deleted rules: a `LintTarget`
+carries `exemptions={rule_id: reason}`, the finding is still computed
+and reported but does not count as a violation, and the reason string
+is printed beside it — the contract stays visible where it is waived.
+
+No jax at module level: the registry must be importable by conftest
+(for the coverage meta-check) and by golden-file tests without a
+backend.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from collections import Counter
+from typing import Callable, Dict, List, Optional, Tuple
+
+from distributed_model_parallel_tpu.analysis.collectives import (
+    ClassifiedCollective,
+    MeshModel,
+    classify,
+    monolithic_over,
+    nonscalar_all_reduces,
+    ring_permutes_over,
+)
+from distributed_model_parallel_tpu.analysis.hlo import (
+    DTYPE_BYTES,
+    HloModule,
+    parse_hlo,
+)
+
+
+@dataclasses.dataclass(frozen=True)
+class LintTarget:
+    """What the lint driver lowered: engine, mode, mesh facts, and the
+    expectations rules pin against (bucket plans, at-rest layouts).
+    Everything beyond `name`/`engine` has a safe default so golden
+    tests can construct minimal targets."""
+
+    name: str
+    # dp | ddp | fsdp | tp | sp | sp_lm | pipeline | cm_ag | cm_rs
+    engine: str
+    grad_reduction: str = "monolithic"
+    collective_matmul: bool = False
+    bf16: bool = False
+    donate: bool = False
+
+    # Mesh facts (filled from the mesh the combo was lowered on).
+    data_axes: Tuple[str, ...] = ("data",)
+    ici_axis: Optional[str] = "data"
+    dcn_axis: Optional[str] = None
+    ici_size: int = 1
+    dcn_size: int = 1
+    cm_axis: Optional[str] = None  # the axis opted-in rings run over
+    cm_size: int = 0
+
+    # Reducer expectations: per backward segment, a tuple of
+    # (padded_elems, dtype_token) bucket descriptors — one segment for
+    # "bucketed", `overlap_segments` of them for "overlapped".
+    bucket_plans: Tuple[Tuple[Tuple[int, str], ...], ...] = ()
+    overlap_segments: int = 0
+
+    # Collective-matmul expectations.
+    expected_permutes: Optional[int] = None  # op-level exact pin
+    cm_min_ring_permutes: int = 0  # engine-level floor
+    # jaxpr metadata: ((axis_names, dtype_token, scope), ...) for every
+    # `ppermute` equation in the traced step. Compiled CPU HLO cannot
+    # carry dtype contracts (the backend's float-normalization pass
+    # legalizes bf16 collectives to f32 + converts), so the bf16 ring
+    # rule reads the trace-level dtypes instead; `scope` is the
+    # equation's name_stack string (see lint.jaxpr_ppermute_dtypes).
+    ring_dtypes: Tuple[Tuple[Tuple[str, ...], str, str], ...] = ()
+
+    # At-rest / donation expectations.
+    fsdp_full_leaf_shapes: Tuple[Tuple[int, ...], ...] = ()
+    n_param_leaves: int = 0
+    # Non-scalar all-reduce allowlist: BN state / batch-stat shapes.
+    state_leaf_shapes: Tuple[Tuple[int, ...], ...] = ()
+
+    # rule_id -> reason; the finding is reported but not counted
+    # (module docstring).
+    exemptions: Dict[str, str] = dataclasses.field(default_factory=dict)
+
+
+@dataclasses.dataclass(frozen=True)
+class Finding:
+    rule: str
+    severity: str
+    message: str
+    instruction: Optional[str] = None
+    exempted: bool = False
+    exemption_reason: str = ""
+
+
+@dataclasses.dataclass
+class LintContext:
+    """One lowered combo, parsed and classified, handed to every
+    applicable rule."""
+
+    target: LintTarget
+    module: HloModule
+    mesh: MeshModel
+    collectives: List[ClassifiedCollective]
+
+    @classmethod
+    def build(cls, target: LintTarget, hlo_text: str,
+              mesh: MeshModel) -> "LintContext":
+        module = parse_hlo(hlo_text)
+        return cls(
+            target=target,
+            module=module,
+            mesh=mesh,
+            collectives=classify(module, mesh),
+        )
+
+    def finding(self, rule_id: str, message: str,
+                instruction: Optional[str] = None) -> Finding:
+        sev = REGISTRY[rule_id].severity
+        return Finding(rule_id, sev, message, instruction)
+
+    # Shared helpers -------------------------------------------------
+
+    def data_ring_permutes(self) -> List[ClassifiedCollective]:
+        return ring_permutes_over(self.collectives, self.target.ici_axis)
+
+    def total_buckets(self) -> int:
+        return sum(len(p) for p in self.target.bucket_plans)
+
+    def dcn_shard_shapes(self) -> Counter:
+        """Expected multiset of (shape, dtype) for the per-bucket
+        cross-slice all-reduce: each bucket's 1/ici shard of its padded
+        flat buffer."""
+        t = self.target
+        c: Counter = Counter()
+        for plan in t.bucket_plans:
+            for padded, dt in plan:
+                c[((padded // t.ici_size,), dt)] += 1
+        return c
+
+
+@dataclasses.dataclass(frozen=True)
+class Rule:
+    id: str
+    severity: str  # "error" | "warn"
+    contract: str
+    source: str  # the PR whose claim this encodes
+    applies: Callable[[LintTarget], bool]
+    check: Callable[[LintContext], List[Finding]]
+
+
+REGISTRY: Dict[str, Rule] = {}
+
+
+def rule(*, id: str, severity: str, contract: str, source: str,
+         applies: Callable[[LintTarget], bool]):
+    def deco(fn):
+        if id in REGISTRY:
+            raise ValueError(f"duplicate rule id {id!r}")
+        REGISTRY[id] = Rule(id, severity, contract, source, applies, fn)
+        return fn
+    return deco
+
+
+def run_rules(ctx: LintContext) -> List[Finding]:
+    """Run every applicable rule; exempted findings come back flagged
+    (reported, not counted — the driver's summary distinguishes)."""
+    out: List[Finding] = []
+    for r in REGISTRY.values():
+        if not r.applies(ctx.target):
+            continue
+        for f in r.check(ctx):
+            reason = ctx.target.exemptions.get(r.id)
+            if reason is not None:
+                f = dataclasses.replace(
+                    f, exempted=True, exemption_reason=reason
+                )
+            out.append(f)
+    return out
+
+
+def _is_reducer(t: LintTarget) -> bool:
+    return (
+        t.grad_reduction in ("bucketed", "overlapped")
+        and t.engine in ("ddp", "fsdp", "sp_lm")
+    )
+
+
+# ------------------------------------------------------------------ rules
+
+
+@rule(
+    id="dcn-grad-all-reduce", severity="error", source="PR 4",
+    contract=(
+        "On bucketed/overlapped paths over a hybrid dcn x ici mesh, no "
+        "all-reduce crossing 'dcn' may carry more than one bucket's "
+        "1/ici shard — the slow fabric never sees a full gradient."
+    ),
+    applies=lambda t: _is_reducer(t) and t.dcn_size > 1,
+)
+def _dcn_grad_all_reduce(ctx: LintContext) -> List[Finding]:
+    t = ctx.target
+    limit = 0
+    for plan in t.bucket_plans:
+        for padded, dt in plan:
+            itemsize = DTYPE_BYTES.get(dt, 4)
+            limit = max(limit, (padded // t.ici_size) * itemsize)
+    out = []
+    for c in nonscalar_all_reduces(ctx.collectives):
+        if c.crosses(t.dcn_axis) and c.payload_bytes > limit:
+            out.append(ctx.finding(
+                "dcn-grad-all-reduce",
+                f"{c.name}: {c.payload_bytes} B all-reduce crosses "
+                f"'{t.dcn_axis}' (largest allowed bucket shard: "
+                f"{limit} B)",
+                c.name,
+            ))
+    return out
+
+
+@rule(
+    id="bucket-ring-permutes", severity="error", source="PR 4",
+    contract=(
+        "Each bucket reduces as chunked ppermute rings: exactly "
+        "2(S-1) collective-permutes per bucket over the intra-slice "
+        "fabric (ring reduce-scatter + ring all-gather), summed over "
+        "the per-segment bucket plans."
+    ),
+    applies=_is_reducer,
+)
+def _bucket_ring_permutes(ctx: LintContext) -> List[Finding]:
+    t = ctx.target
+    expected = 2 * (t.ici_size - 1) * ctx.total_buckets()
+    actual = len(ctx.data_ring_permutes())
+    if actual != expected:
+        return [ctx.finding(
+            "bucket-ring-permutes",
+            f"{actual} ring permutes over '{t.ici_axis}', expected "
+            f"2*({t.ici_size}-1)*{ctx.total_buckets()} = {expected}",
+        )]
+    return []
+
+
+@rule(
+    id="dcn-bucket-psum-shard", severity="error", source="PR 4",
+    contract=(
+        "On a hybrid mesh, each bucket crosses 'dcn' exactly once, as "
+        "an all-reduce shape-pinned at the bucket's 1/ici shard of its "
+        "padded flat buffer."
+    ),
+    applies=lambda t: _is_reducer(t) and t.dcn_size > 1,
+)
+def _dcn_bucket_psum_shard(ctx: LintContext) -> List[Finding]:
+    t = ctx.target
+    expected = ctx.dcn_shard_shapes()
+    actual: Counter = Counter()
+    for c in nonscalar_all_reduces(ctx.collectives):
+        if c.axes is not None and c.axes == {t.dcn_axis}:
+            for b in c.instruction.buffers:
+                actual[(b.shape, b.dtype)] += 1
+    if actual != expected:
+        return [ctx.finding(
+            "dcn-bucket-psum-shard",
+            f"dcn-only all-reduce shapes {dict(actual)} != expected "
+            f"per-bucket 1/ici shards {dict(expected)}",
+        )]
+    return []
+
+
+@rule(
+    id="no-grad-all-reduce", severity="error", source="PR 4",
+    contract=(
+        "Bucketed/overlapped steps keep ZERO grad-sized all-reduces "
+        "over the data fabric: every non-scalar all-reduce touching "
+        "the data axes must be either a pinned per-bucket dcn shard or "
+        "a BatchNorm statistics reduction (state-leaf shaped)."
+    ),
+    applies=_is_reducer,
+)
+def _no_grad_all_reduce(ctx: LintContext) -> List[Finding]:
+    t = ctx.target
+    allowed_state = set(t.state_leaf_shapes)
+    dcn_shards = ctx.dcn_shard_shapes()
+    out = []
+    for c in nonscalar_all_reduces(ctx.collectives):
+        if c.axes is None:
+            out.append(ctx.finding(
+                "no-grad-all-reduce",
+                f"{c.name}: unclassifiable replica groups on a "
+                "non-scalar all-reduce",
+                c.name,
+            ))
+            continue
+        if not (c.axes & set(t.data_axes)):
+            continue  # another fabric's reduction ('seq', 'stage', ...)
+        if c.axes == {t.dcn_axis}:
+            # EVERY buffer must match a pinned shard: a combiner-fused
+            # tuple all-reduce must not smuggle a grad-sized buffer
+            # over 'dcn' behind one legitimate bucket shard.
+            if c.instruction.buffers and all(
+                (b.shape, b.dtype) in dcn_shards
+                for b in c.instruction.buffers
+            ):
+                continue  # the pinned cross-slice bucket hop
+        if all(b.shape in allowed_state for b in c.instruction.buffers):
+            continue  # BN running-stat / batch-stat psum
+        out.append(ctx.finding(
+            "no-grad-all-reduce",
+            f"{c.name}: non-scalar all-reduce over {sorted(c.axes)} "
+            f"carrying {c.shapes} — grad-sized traffic outside the "
+            "bucket rings",
+            c.name,
+        ))
+    return out
+
+
+@rule(
+    id="cm-ring-permutes", severity="error", source="PR 2",
+    contract=(
+        "A collective-matmul ring is exactly S-1 collective-permutes "
+        "per kernel (op-level pin); an opted-in engine step carries at "
+        "least its projection sites' worth of ring permutes over the "
+        "cm axis."
+    ),
+    applies=lambda t: t.engine in ("cm_ag", "cm_rs")
+    or (t.collective_matmul and t.cm_axis is not None),
+)
+def _cm_ring_permutes(ctx: LintContext) -> List[Finding]:
+    t = ctx.target
+    rings = ring_permutes_over(ctx.collectives, t.cm_axis)
+    out = []
+    if t.expected_permutes is not None:
+        if len(rings) != t.expected_permutes:
+            out.append(ctx.finding(
+                "cm-ring-permutes",
+                f"{len(rings)} ring permutes over '{t.cm_axis}', "
+                f"expected exactly {t.expected_permutes}",
+            ))
+    elif len(rings) < t.cm_min_ring_permutes:
+        out.append(ctx.finding(
+            "cm-ring-permutes",
+            f"{len(rings)} ring permutes over '{t.cm_axis}', expected "
+            f">= {t.cm_min_ring_permutes} (the opted-in projection "
+            "sites' rings)",
+        ))
+    return out
+
+
+@rule(
+    id="cm-monolithic-collective", severity="error", source="PR 2",
+    contract=(
+        "An opted-in collective-matmul site leaves NO monolithic "
+        "all-gather/reduce-scatter on its axis: op-level kernels must "
+        "be permute-only; SP engine steps (whose only cm-axis gathers "
+        "would be the rings' replacements) must keep zero. The TP "
+        "engine is judged only at op level — its embedding/head keep "
+        "legitimate partitioner gathers."
+    ),
+    applies=lambda t: t.engine in ("cm_ag", "cm_rs")
+    or (t.collective_matmul and t.engine in ("sp", "sp_lm")),
+)
+def _cm_monolithic(ctx: LintContext) -> List[Finding]:
+    t = ctx.target
+    out = []
+    if t.engine in ("cm_ag", "cm_rs"):
+        bad = [
+            c for c in ctx.collectives
+            if c.kind in ("all-gather", "reduce-scatter", "all-reduce")
+        ]
+    else:
+        bad = monolithic_over(ctx.collectives, t.cm_axis)
+    for c in bad:
+        out.append(ctx.finding(
+            "cm-monolithic-collective",
+            f"{c.name}: monolithic {c.kind} on the opted-in "
+            f"'{t.cm_axis}' ring path",
+            c.name,
+        ))
+    return out
+
+
+@rule(
+    id="fsdp-at-rest-sharded", severity="error", source="PR 2/PR 4",
+    contract=(
+        "FSDP parameters are never fully materialized at rest: no "
+        "entry parameter of the compiled step may carry the FULL shape "
+        "of a shardable leaf (every leaf >= min_shard_elems with a "
+        "divisible dim lives 1/N on device)."
+    ),
+    applies=lambda t: t.engine == "fsdp",
+)
+def _fsdp_at_rest(ctx: LintContext) -> List[Finding]:
+    t = ctx.target
+    out = []
+    if not t.fsdp_full_leaf_shapes:
+        return [ctx.finding(
+            "fsdp-at-rest-sharded",
+            "the at-rest policy shards nothing (no shardable leaves) — "
+            "the contract is vacuous for this model/mesh",
+        )]
+    full = set(t.fsdp_full_leaf_shapes)
+    for p in ctx.module.entry_parameters():
+        for b in p.buffers:
+            if b.shape in full:
+                out.append(ctx.finding(
+                    "fsdp-at-rest-sharded",
+                    f"entry parameter {p.name} carries full shape "
+                    f"{b.shape} of a shardable leaf — materialized at "
+                    "rest",
+                    p.name,
+                ))
+    return out
+
+
+@rule(
+    id="overlap-first-bucket-free", severity="error", source="PR 5",
+    contract=(
+        "Under grad_reduction='overlapped', the FIRST-fired bucket's "
+        "ring permutes (last segment's — late layers differentiate "
+        "first) carry no transitive dependency on segment 0's backward "
+        "ops; segment 0's own bucket MUST depend on them (the control "
+        "that keeps the analysis non-vacuous)."
+    ),
+    applies=lambda t: t.grad_reduction == "overlapped"
+    and t.engine in ("ddp", "fsdp", "sp_lm") and t.ici_size > 1,
+)
+def _overlap_first_bucket(ctx: LintContext) -> List[Finding]:
+    t = ctx.target
+    m = ctx.module
+    s = t.overlap_segments
+    first = m.tagged(f"grad_reduce_stage{s - 1}", "collective-permute")
+    bwd0 = set(m.tagged("bwd_stage0"))
+    out = []
+    if not first:
+        out.append(ctx.finding(
+            "overlap-first-bucket-free",
+            f"no ring permutes tagged grad_reduce_stage{s - 1} — the "
+            "first-fired bucket left no trace (tags moved?)",
+        ))
+    if not bwd0:
+        out.append(ctx.finding(
+            "overlap-first-bucket-free",
+            "no ops tagged bwd_stage0 — segment-0 backward left no "
+            "trace (tags moved?)",
+        ))
+    if out:
+        return out
+    for p in first:
+        if m.depends_on(p, bwd0):
+            out.append(ctx.finding(
+                "overlap-first-bucket-free",
+                f"first-fired bucket permute {p} depends on segment-0 "
+                "backward — the eager firing serialized",
+                p,
+            ))
+    last = m.tagged("grad_reduce_stage0", "collective-permute")
+    if not last or not all(m.depends_on(p, bwd0) for p in last):
+        out.append(ctx.finding(
+            "overlap-first-bucket-free",
+            "positive control failed: segment 0's own bucket does not "
+            "depend on segment-0 backward — the dependency analysis "
+            "is vacuous",
+        ))
+    return out
+
+
+@rule(
+    id="prefetch-gather-free", severity="error", source="PR 5",
+    contract=(
+        "FSDP overlapped: the prefetched all-gather of segment k-1's "
+        "weights depends only on the parameter shards — never on ANY "
+        "segment's bucket-ring ops — so the scheduler may hoist it "
+        "behind the in-flight reduction."
+    ),
+    applies=lambda t: t.engine == "fsdp"
+    and t.grad_reduction == "overlapped",
+)
+def _prefetch_gather_free(ctx: LintContext) -> List[Finding]:
+    t = ctx.target
+    m = ctx.module
+    reduce_ops: set = set()
+    for k in range(t.overlap_segments):
+        reduce_ops |= set(m.tagged(f"grad_reduce_stage{k}"))
+    out = []
+    if not reduce_ops:
+        return [ctx.finding(
+            "prefetch-gather-free",
+            "no grad_reduce_stage* tagged ops — the reduction left no "
+            "trace (tags moved?)",
+        )]
+    for k in range(t.overlap_segments - 1):
+        gathers = m.tagged(f"prefetch_gather_stage{k}", "all-gather")
+        if not gathers:
+            out.append(ctx.finding(
+                "prefetch-gather-free",
+                f"no prefetched all-gather tagged "
+                f"prefetch_gather_stage{k}",
+            ))
+            continue
+        for g in gathers:
+            if m.depends_on(g, reduce_ops):
+                out.append(ctx.finding(
+                    "prefetch-gather-free",
+                    f"prefetch gather {g} (segment {k}) depends on a "
+                    "bucket reduction — the ZeRO overlap serialized",
+                    g,
+                ))
+    return out
+
+
+# Named-scope exemption for bf16-ring-upcast: permutes whose trace
+# scope carries one of these names ride f32 ON PURPOSE and are not
+# upcast findings. `kv_ring` is ring attention's K/V rotation
+# (ops/ring_attention.py): its dk/dv cotangents retrace the reversed
+# ring in the wire dtype, so a bf16 wire would accumulate each block's
+# gradient through n-1 bf16 roundings — the module's contract is
+# "accumulate in f32 end to end", and the wire pays 2x bytes for it.
+# Matched as a whole scope-name WORD (\b-delimited), never a substring:
+# a future `qkv_ring` or `kv_ring_cache` scope must not inherit the
+# exemption silently.
+BF16_RING_EXEMPT_SCOPES = ("kv_ring",)
+
+
+def _scope_exempt(scope: str) -> bool:
+    import re as _re
+
+    return any(
+        _re.search(rf"\b{_re.escape(s)}\b", scope)
+        for s in BF16_RING_EXEMPT_SCOPES
+    )
+
+
+@rule(
+    id="bf16-ring-upcast", severity="error", source="PR 2/PR 6",
+    contract=(
+        "Inside an opted-in bf16 region (compute_dtype=bfloat16 with "
+        "collective-matmul rings), every ppermute over the cm axis "
+        "carries a bf16 payload — an f32 permute is a silent upcast "
+        "doubling the ring bytes. Checked from the traced jaxpr (the "
+        "CPU backend's float-normalization pass rewrites compiled-HLO "
+        "collectives to f32, so only trace-level dtypes carry this "
+        "contract). Scopes in BF16_RING_EXEMPT_SCOPES (the KV ring's "
+        "deliberate f32 wire) are exempt."
+    ),
+    applies=lambda t: t.bf16 and (
+        t.engine in ("cm_ag", "cm_rs") or t.collective_matmul
+    ),
+)
+def _bf16_ring_upcast(ctx: LintContext) -> List[Finding]:
+    t = ctx.target
+    if not t.ring_dtypes:
+        return [ctx.finding(
+            "bf16-ring-upcast",
+            "no jaxpr ppermute dtypes collected for a bf16 ring combo "
+            "— the dtype contract was not checked",
+        )]
+    out = []
+    for axes, dt, scope in t.ring_dtypes:
+        if _scope_exempt(scope):
+            continue
+        if t.cm_axis in axes and dt == "f32":
+            out.append(ctx.finding(
+                "bf16-ring-upcast",
+                f"f32 ppermute over '{t.cm_axis}' in the traced step "
+                f"(scope {scope!r}) — silent upcast on an opted-in "
+                "bf16 ring",
+            ))
+    return out
+
+
+@rule(
+    id="donated-step-aliased", severity="warn", source="PR 1/PR 6",
+    contract=(
+        "A train step built with donate=True must alias its state "
+        "buffers input->output (one alias entry per parameter/optimizer "
+        "leaf); a missing alias table double-buffers the whole state "
+        "every step."
+    ),
+    applies=lambda t: t.donate and t.n_param_leaves > 0,
+)
+def _donated_step_aliased(ctx: LintContext) -> List[Finding]:
+    t = ctx.target
+    n = ctx.module.input_output_aliases
+    if n < t.n_param_leaves:
+        return [ctx.finding(
+            "donated-step-aliased",
+            f"input_output_alias covers {n} buffers, expected at least "
+            f"{t.n_param_leaves} (the parameter/optimizer leaves) — "
+            "the donated state is double-buffered",
+        )]
+    return []
+
+
+@rule(
+    id="collective-fabric-known", severity="warn", source="PR 6",
+    contract=(
+        "Every collective's replica groups / permute pairs resolve to "
+        "mesh coordinates — an unclassifiable collective means the "
+        "fabric rules above ran blind on it."
+    ),
+    applies=lambda t: True,
+)
+def _collective_fabric_known(ctx: LintContext) -> List[Finding]:
+    out = []
+    for c in ctx.collectives:
+        has_membership = (
+            c.instruction.replica_groups is not None
+            or c.instruction.source_target_pairs is not None
+        )
+        if has_membership and c.axes is None:
+            out.append(ctx.finding(
+                "collective-fabric-known",
+                f"{c.name}: {c.kind} membership does not resolve to "
+                "mesh coordinates",
+                c.name,
+            ))
+    return out
+
+
+__all__ = [
+    "Finding",
+    "LintContext",
+    "LintTarget",
+    "REGISTRY",
+    "Rule",
+    "rule",
+    "run_rules",
+]

@@ -253,6 +253,13 @@ def project(h, w, b, ctx: Context, *, role: str, scope: str):
     'row'-role ones (attn-out / ffn-out) as `matmul_rs` rings
     (`ops/collective_matmul.py`); otherwise this is exactly `h @ w + b`.
     """
+    # Params are f32 masters; compute follows the activation dtype (the
+    # `linear` layer's convention). Without this cast a bf16 model
+    # silently upcast to f32 at its FIRST projection — and the opted-in
+    # rings carried f32 payloads (2x the bytes); the hlolint rule
+    # `bf16-ring-upcast` pins the fixed behavior.
+    w = w.astype(h.dtype)
+    b = b.astype(h.dtype)
     mm = ctx.matmul
     if mm is not None and getattr(mm, scope):
         return (mm.column if role == "column" else mm.row)(h, w, b)

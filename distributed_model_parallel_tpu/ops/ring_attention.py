@@ -83,6 +83,13 @@ def ring_attention(
     n = lax.psum(1, axis_name)  # static ring size
     s_idx = lax.axis_index(axis_name)
     qf = q.astype(jnp.float32) * scale
+    # K/V ride the ring in f32 ON PURPOSE (2x the wire bytes of the
+    # bf16 input): the dk/dv cotangents retrace the reversed ring in
+    # the SAME dtype, so an input-dtype wire would accumulate each
+    # block's gradient through n-1 bf16 roundings — breaking the
+    # module contract ("accumulate in f32 end to end"). hlolint's
+    # `bf16-ring-upcast` rule exempts the `kv_ring`-scoped permutes
+    # for exactly this reason.
     kb = k.astype(jnp.float32)
     vb = v.astype(jnp.float32)
     maskb = (
@@ -119,9 +126,14 @@ def ring_attention(
         # loop would pay one extra full K/V transfer whose result is
         # discarded — pure ICI waste on the long-context hot path).
         acc, kb, vb, maskb = carry
-        kb, vb, maskb = (
-            lax.ppermute(x, axis_name, perm) for x in (kb, vb, maskb)
-        )
+        # The scope names these permutes in the traced jaxpr so the
+        # hlolint `bf16-ring-upcast` rule can exempt the deliberately
+        # f32 KV wire without unpinning the collective-matmul rings.
+        with jax.named_scope("kv_ring"):
+            kb, vb, maskb = (
+                lax.ppermute(x, axis_name, perm)
+                for x in (kb, vb, maskb)
+            )
         if causal:
             # Block arriving at step r originated on shard (s - r - 1)
             # mod n: visible iff it sits strictly below us in the global

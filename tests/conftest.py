@@ -93,6 +93,64 @@ def pytest_collection_modifyitems(config, items):
             + ", ".join(sorted(undocumented))
         )
 
+    # hlolint rule-coverage meta-check: every rule in the registry must
+    # be exercised by at least one positive (violation detected) AND one
+    # negative (clean) test, declared via @pytest.mark.hlo_rule(id,
+    # polarity). A rule nobody can trip is a rule nobody can trust; a
+    # rule with no clean case may be firing on everything. The registry
+    # import is jax-free (analysis/rules.py module contract). Enforced
+    # only on directory-style collection (the tier-1 gate's `pytest
+    # tests/`) or when the rules module itself was collected — a
+    # single-OTHER-file rerun must not fail for tests it never selected;
+    # directory collection still catches a deleted/emptied rules module.
+    import os
+
+    dir_collection = any(
+        os.path.isdir(a.split("::")[0]) for a in config.args
+    )
+    rules_collected = any(
+        str(getattr(i, "fspath", "")).endswith("test_hlo_rules.py")
+        for i in items
+    )
+    if not (dir_collection or rules_collected):
+        return
+    from distributed_model_parallel_tpu.analysis.rules import REGISTRY
+
+    covered = {}
+    for item in items:
+        for m in item.iter_markers("hlo_rule"):
+            if len(m.args) != 2:
+                raise pytest.UsageError(
+                    f"{item.nodeid}: hlo_rule marker takes exactly "
+                    f"(rule_id, polarity) as positional args, got "
+                    f"{m.args!r}"
+                )
+            rule_id, polarity = m.args
+            if rule_id not in REGISTRY:
+                raise pytest.UsageError(
+                    f"{item.nodeid}: hlo_rule marker names unknown rule "
+                    f"{rule_id!r} (registry: {sorted(REGISTRY)})"
+                )
+            if polarity not in ("positive", "negative"):
+                raise pytest.UsageError(
+                    f"{item.nodeid}: hlo_rule polarity must be "
+                    f"'positive' or 'negative', got {polarity!r}"
+                )
+            covered.setdefault(rule_id, set()).add(polarity)
+    missing = [
+        f"{rid} (missing: "
+        + ", ".join(sorted({"positive", "negative"} - covered.get(rid, set())))
+        + ")"
+        for rid in sorted(REGISTRY)
+        if covered.get(rid, set()) != {"positive", "negative"}
+    ]
+    if missing:
+        raise pytest.UsageError(
+            "every hlolint rule needs one positive and one negative "
+            "test (tag with @pytest.mark.hlo_rule(id, polarity), see "
+            "tests/test_hlo_rules.py): " + "; ".join(missing)
+        )
+
 
 @pytest.fixture(scope="session")
 def devices():

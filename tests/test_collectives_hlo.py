@@ -2,9 +2,17 @@
 collectives INTERNALS.md's inventory claims — a CI guard that a future
 refactor can't silently drop an all-reduce (numerics tests would catch
 the wrong RESULT, but only on multi-sample tolerance; this pins the
-mechanism)."""
+mechanism).
 
-import re
+The parsing/counting/reachability machinery that used to live here as
+private helpers is now the shared static-analysis library
+(`distributed_model_parallel_tpu/analysis/` — this PR's tentpole): the
+text-level pins import `collective_counts`/`has_op_with_result`/
+`nonscalar_all_reduce_count`, and the dependency pins run on
+`parse_hlo`'s instruction graph (`HloModule.tagged`/`depends_on`, the
+same conservative reachability). tests/test_hlolint.py lints the full
+engine matrix through the same library's rule registry."""
+
 from functools import partial
 
 import jax
@@ -12,6 +20,16 @@ import jax.numpy as jnp
 import numpy as np
 import pytest
 
+from distributed_model_parallel_tpu.analysis.hlo import (
+    collective_counts as _collective_counts,
+    has_op_with_result as _has_op_with_result,
+    nonscalar_all_reduce_count as _nonscalar_all_reduce_count,
+    parse_hlo,
+)
+from distributed_model_parallel_tpu.analysis.lint import (
+    image_batch as _batch,
+    staged_mlp as _staged_mlp,
+)
 from distributed_model_parallel_tpu.models.tinycnn import tiny_cnn
 from distributed_model_parallel_tpu.runtime.mesh import MeshSpec, make_mesh
 from distributed_model_parallel_tpu.training.optim import SGD
@@ -19,48 +37,6 @@ from distributed_model_parallel_tpu.training.optim import SGD
 
 def _hlo(engine, *args):
     return engine.train_step.lower(*args).compile().as_text()
-
-
-# A collective op's result type: a plain shape token on sync backends
-# (`= f32[8,16]{1,0} all-gather(`) or a parenthesized tuple on async
-# ones (`= (f32[...], f32[...]) all-gather-start(`).
-_RESULT = r"(?:\([^)\n]*\)|\S+)"
-
-
-def _collective_counts(hlo: str) -> dict:
-    """Occurrences of each collective OP (not operand mentions) in
-    compiled HLO text; async backends emit `<op>-start`/`-done` pairs,
-    counted once via the -start form."""
-
-    def n(op):
-        return len(re.findall(rf"= {_RESULT} {op}(?:-start)?\(", hlo))
-
-    return {
-        "collective-permute": n("collective-permute"),
-        "all-gather": n("all-gather"),
-        "reduce-scatter": n("reduce-scatter"),
-        "all-reduce": n("all-reduce"),
-        "all-to-all": n("all-to-all"),
-    }
-
-
-def _has_op_with_result(hlo: str, op: str, shape: str) -> bool:
-    """True when an `op` whose RESULT carries `shape` exists — matched
-    on the op's definition line (sync or async-start form), never on
-    operand mentions."""
-    pat = (
-        rf"= (?:\([^)\n]*{re.escape(shape)}[^)\n]*\)|{re.escape(shape)}"
-        rf"\S*) {op}(?:-start)?\("
-    )
-    return re.search(pat, hlo) is not None
-
-
-def _batch(n, hw=8, classes=4, seed=0):
-    rng = np.random.RandomState(seed)
-    return (
-        rng.rand(n, hw, hw, 3).astype(np.float32),
-        rng.randint(0, classes, size=(n,)).astype(np.int32),
-    )
 
 
 def test_ddp_step_contains_grad_all_reduce():
@@ -393,19 +369,6 @@ def test_fsdp_step_gathers_weights_and_reduce_scatters_grads():
 # pin distinguishes them by result shape.
 
 
-def _nonscalar_all_reduce_count(hlo: str) -> int:
-    """all-reduce ops whose RESULT carries at least one non-scalar
-    buffer — gradient-sized reductions, as opposed to the scalar
-    metrics psums every engine legitimately keeps."""
-    n = 0
-    for m in re.finditer(
-        rf"= ({_RESULT}) all-reduce(?:-start)?\(", hlo
-    ):
-        if re.search(r"\[\d", m.group(1)):
-            n += 1
-    return n
-
-
 def _mlp():
     """BN-free classifier: model_state is empty, so the only all-reduces
     a DDP step may contain are the gradient reduction and the scalar
@@ -543,90 +506,10 @@ def test_fsdp_bucketed_step_gathers_weights_and_rings_grads():
 # identified by the `jax.named_scope` tags the engines trace them
 # under (`grad_reduce_stage{k}`, `bwd_stage{k}`,
 # `prefetch_gather_stage{k}` — carried into compiled HLO as
-# metadata op_name).
-
-
-def _hlo_graph(hlo: str):
-    """(computations, instructions) from compiled-HLO text.
-
-    `computations` maps a computation name to its instruction names;
-    `instructions` maps an instruction name to (op, referenced names,
-    op_name metadata). Referenced names include operands AND called
-    computations (fusion bodies, reduction regions), so reachability
-    over this graph is a conservative over-approximation of data
-    dependence — exactly the safe direction for asserting the ABSENCE
-    of a dependency."""
-    comps: dict = {}
-    instrs: dict = {}
-    current = None
-    for line in hlo.splitlines():
-        s = line.strip()
-        if s.endswith("{") and "= " not in s:
-            m = re.match(r"(?:ENTRY\s+)?%?([\w.\-]+)\s*\(", s)
-            if m:
-                current = m.group(1)
-                comps[current] = []
-                continue
-        if s == "}":
-            current = None
-            continue
-        m = re.match(
-            rf"(?:ROOT\s+)?%([\w.\-]+)\s*=\s*{_RESULT}\s+([\w\-]+)\(", s
-        )
-        if m and current is not None:
-            name, op = m.groups()
-            meta = re.search(r'op_name="([^"]*)"', s)
-            refs = set(re.findall(r"%([\w.\-]+)", s)) - {name}
-            instrs[name] = (op, refs, meta.group(1) if meta else "")
-            comps[current].append(name)
-    return comps, instrs
-
-
-def _depends_on(comps, instrs, start, targets) -> bool:
-    """True when `start` transitively references any name in `targets`
-    (through operands and called computations)."""
-    seen, stack = set(), [start]
-    while stack:
-        n = stack.pop()
-        if n in seen:
-            continue
-        seen.add(n)
-        if n in targets and n != start:
-            return True
-        _, refs, _ = instrs.get(n, (None, set(), ""))
-        for r in refs:
-            if r in comps:
-                stack.extend(comps[r])
-            elif r in instrs:
-                stack.append(r)
-    return False
-
-
-def _tagged(instrs, tag, op_prefix=None):
-    """Instruction names whose op_name metadata carries `tag` (a
-    named-scope segment, matched with its trailing '/' so stage1 never
-    matches stage10), optionally filtered by op prefix."""
-    return [
-        n for n, (op, _, meta) in instrs.items()
-        if f"{tag}/" in meta
-        and (op_prefix is None or op.startswith(op_prefix))
-    ]
-
-
-def _staged_mlp(n_blocks=8, width=32, classes=4):
-    """BN-free stem/blocks/head MLP (staging.staged_model anatomy):
-    no model_state, so the only collectives in an overlapped DDP step
-    are the bucket rings and the scalar metrics psums — and 8 blocks
-    support every S in {2, 4, 8}."""
-    from distributed_model_parallel_tpu.models import layers as L
-    from distributed_model_parallel_tpu.models import staging
-
-    stem = L.sequential(L.flatten(), L.linear(192, width), L.relu())
-    blocks = [
-        L.sequential(L.linear(width, width), L.relu())
-        for _ in range(n_blocks)
-    ]
-    return staging.staged_model(stem, blocks, L.linear(width, classes))
+# metadata op_name). The instruction graph and its conservative
+# reachability are the shared library's (`analysis.hlo.parse_hlo` —
+# the promoted form of the `_hlo_graph`/`_depends_on` helpers that
+# used to live here).
 
 
 @pytest.mark.parametrize("s", [2, 4, 8])
@@ -648,25 +531,22 @@ def test_ddp_overlapped_first_bucket_free_of_stage0_backward(s):
     )
     ts = eng.init_state(jax.random.PRNGKey(0))
     im, lb = eng.shard_batch(*_batch(16))
-    hlo = _hlo(eng, ts, im, lb, jnp.float32(0.1))
-    comps, instrs = _hlo_graph(hlo)
+    mod = parse_hlo(_hlo(eng, ts, im, lb, jnp.float32(0.1)))
 
-    first = _tagged(
-        instrs, f"grad_reduce_stage{s - 1}", "collective-permute"
+    first = mod.tagged(
+        f"grad_reduce_stage{s - 1}", "collective-permute"
     )
-    bwd0 = set(_tagged(instrs, "bwd_stage0"))
+    bwd0 = set(mod.tagged("bwd_stage0"))
     assert first, "first-fired bucket emitted no ring permutes"
     assert bwd0, "stage 0 backward left no tagged ops"
     for p in first:
-        assert not _depends_on(comps, instrs, p, bwd0), (
+        assert not mod.depends_on(p, bwd0), (
             f"S={s}: first bucket permute {p} depends on stage-0 "
             "backward — the eager firing serialized"
         )
     # Positive control — the dependency analysis is not vacuous.
-    last = _tagged(instrs, "grad_reduce_stage0", "collective-permute")
-    assert last and all(
-        _depends_on(comps, instrs, p, bwd0) for p in last
-    )
+    last = mod.tagged("grad_reduce_stage0", "collective-permute")
+    assert last and all(mod.depends_on(p, bwd0) for p in last)
 
 
 def test_ddp_overlapped_keeps_ring_structure_and_no_grad_all_reduce():
@@ -725,20 +605,19 @@ def test_fsdp_overlapped_prefetch_gather_free_of_reduce(s):
     )
     ts = eng.init_state(jax.random.PRNGKey(0))
     im, lb = eng.shard_batch(*_batch(64))
-    hlo = _hlo(eng, ts, im, lb, jnp.float32(0.1))
-    comps, instrs = _hlo_graph(hlo)
+    mod = parse_hlo(_hlo(eng, ts, im, lb, jnp.float32(0.1)))
 
-    reduce_ops = set(_tagged(instrs, "grad_reduce_stage0"))
+    reduce_ops = set(mod.tagged("grad_reduce_stage0"))
     for k in range(s):
-        reduce_ops |= set(_tagged(instrs, f"grad_reduce_stage{k}"))
+        reduce_ops |= set(mod.tagged(f"grad_reduce_stage{k}"))
     assert reduce_ops
     for k in range(s - 1):
-        gathers = _tagged(
-            instrs, f"prefetch_gather_stage{k}", "all-gather"
+        gathers = mod.tagged(
+            f"prefetch_gather_stage{k}", "all-gather"
         )
         assert gathers, f"no prefetched all-gather for stage {k}"
         for g in gathers:
-            assert not _depends_on(comps, instrs, g, reduce_ops), (
+            assert not mod.depends_on(g, reduce_ops), (
                 f"S={s}: prefetch gather {g} (stage {k}) depends on a "
                 "bucket reduction — the ZeRO overlap serialized"
             )

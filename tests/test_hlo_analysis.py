@@ -1,0 +1,221 @@
+"""Golden-file tests for the HLO parser/classifier
+(`analysis/hlo.py`, `analysis/collectives.py`): canned HLO text, no
+mesh construction, no lowering — fast tier-1 coverage of the parsing
+edge cases the engine matrix exercises only incidentally (nested
+computations, missing metadata, empty/iota replica groups, async
+start/done pairs, tuple results, alias tables)."""
+
+import pytest
+
+from distributed_model_parallel_tpu.analysis.collectives import (
+    MeshModel,
+    classify,
+    monolithic_over,
+    nonscalar_all_reduces,
+    ring_permutes_over,
+)
+from distributed_model_parallel_tpu.analysis.hlo import (
+    Buffer,
+    collective_counts,
+    has_op_with_result,
+    nonscalar_all_reduce_count,
+    parse_hlo,
+    parse_replica_groups,
+    parse_result_buffers,
+)
+
+# A hand-written module exercising: header alias table, a nested
+# reduction region, an ENTRY computation, explicit + iota replica
+# groups, permute pairs, named-scope metadata, a metadata-free line,
+# an async all-gather pair, and a tuple-result instruction.
+GOLDEN = """\
+HloModule jit_step, is_scheduled=true, input_output_alias={ {0}: (0, {}, may-alias), {1}: (1, {}, may-alias), {2}: (2, {}, may-alias) }, entry_computation_layout={(f32[8]{0})->f32[8]{0}}
+
+%region_0.4 (a.1: f32[], b.1: f32[]) -> f32[] {
+  %a.1 = f32[] parameter(0)
+  %b.1 = f32[] parameter(1)
+  ROOT %add.9 = f32[] add(f32[] %a.1, f32[] %b.1)
+}
+
+%fused_computation (p0: f32[8]) -> f32[8] {
+  %p0 = f32[8]{0} parameter(0)
+  ROOT %mul.3 = f32[8]{0} multiply(f32[8]{0} %p0, f32[8]{0} %p0)
+}
+
+ENTRY %main.9_spmd (param: f32[8], param.1: f32[2,4], param.2: s32[]) -> f32[8] {
+  %param = f32[8]{0} parameter(0)
+  %param.1 = f32[2,4]{1,0} parameter(1)
+  %param.2 = s32[] parameter(2)
+  %fusion = f32[8]{0} fusion(f32[8]{0} %param), kind=kLoop, calls=%fused_computation, metadata={op_name="jit(step)/grad_reduce_stage1/mul"}
+  %ar.0 = f32[8]{0} all-reduce(f32[8]{0} %fusion), channel_id=1, replica_groups={{0,1,2,3},{4,5,6,7}}, use_global_device_ids=true, to_apply=%region_0.4, metadata={op_name="jit(step)/grad_reduce_stage1/psum"}
+  %ar.scalar = f32[] all-reduce(f32[] %param.2), channel_id=2, replica_groups={{0,4},{1,5},{2,6},{3,7}}, use_global_device_ids=true, to_apply=%region_0.4, metadata={op_name="jit(step)/metrics/psum"}
+  %cp.0 = f32[8]{0} collective-permute(f32[8]{0} %ar.0), channel_id=3, source_target_pairs={{0,1},{1,2},{2,3},{3,0}}, metadata={op_name="jit(step)/bwd_stage0/ppermute"}
+  %ag-start = (f32[8]{0}, f32[32]{0}) all-gather-start(f32[8]{0} %cp.0), channel_id=4, replica_groups=[2,4]<=[8], dimensions={0}, use_global_device_ids=true
+  %ag-done = f32[32]{0} all-gather-done((f32[8]{0}, f32[32]{0}) %ag-start)
+  %notag = f32[8]{0} slice(f32[32]{0} %ag-done), slice={[0:8]}
+  ROOT %out = f32[8]{0} add(f32[8]{0} %notag, f32[8]{0} %param)
+}
+"""
+
+# A 2x4 dcn x ici mesh: device d at coords (d // 4, d % 4).
+MESH_2x4 = MeshModel(
+    axis_names=("dcn", "ici"),
+    shape=(2, 4),
+    coords={d: (d // 4, d % 4) for d in range(8)},
+)
+
+
+def test_parse_module_structure():
+    m = parse_hlo(GOLDEN)
+    assert m.entry == "main.9_spmd"
+    assert set(m.computations) == {
+        "region_0.4", "fused_computation", "main.9_spmd",
+    }
+    assert m.input_output_aliases == 3
+    params = m.entry_parameters()
+    assert [p.name for p in params] == ["param", "param.1", "param.2"]
+    assert params[1].buffers == (Buffer("f32", (2, 4)),)
+
+
+def test_parse_replica_group_forms():
+    assert parse_replica_groups("{{0,1},{2,3}}") == ((0, 1), (2, 3))
+    assert parse_replica_groups("{}") == ()
+    assert parse_replica_groups("[2,4]<=[8]") == (
+        (0, 1, 2, 3), (4, 5, 6, 7),
+    )
+    # transposed iota: arange(8).reshape(2,4).T.reshape(4,2)
+    assert parse_replica_groups("[4,2]<=[2,4]T(1,0)") == (
+        (0, 4), (1, 5), (2, 6), (3, 7),
+    )
+    assert parse_replica_groups("bogus") is None
+
+
+def test_parse_result_buffer_forms():
+    assert parse_result_buffers("f32[2,4]{1,0}") == (
+        Buffer("f32", (2, 4)),
+    )
+    assert parse_result_buffers("pred[]") == (Buffer("pred", ()),)
+    assert parse_result_buffers("(f32[8]{0}, u32[])") == (
+        Buffer("f32", (8,)), Buffer("u32", ()),
+    )
+    assert Buffer("bf16", (4, 4)).nbytes == 32
+    assert Buffer("f32", ()).is_scalar
+
+
+def test_async_pair_counted_once():
+    m = parse_hlo(GOLDEN)
+    names = [c.name for c in m.collectives()]
+    assert "ag-start" in names and "ag-done" not in names
+    # text-level twin agrees
+    assert collective_counts(GOLDEN)["all-gather"] == 1
+
+
+def test_missing_metadata_and_tagging():
+    m = parse_hlo(GOLDEN)
+    assert m.instructions["notag"].op_name == ""
+    assert m.tagged("grad_reduce_stage1") == ["fusion", "ar.0"]
+    # trailing-slash discipline: stage1 never matches a stage10 tag
+    assert m.tagged("grad_reduce_stage") == []
+    assert m.tagged("grad_reduce_stage1", "all-reduce") == ["ar.0"]
+
+
+def test_reachability_through_called_computations():
+    m = parse_hlo(GOLDEN)
+    # ar.0 -> fusion -> (calls) fused_computation -> p0; and transitively
+    # back to the entry parameter through the fusion operand.
+    assert m.depends_on("ar.0", {"param"})
+    assert m.depends_on("out", {"ar.0"})
+    assert not m.depends_on("fusion", {"cp.0"})
+    # a name that appears nowhere is unreachable, not an error
+    assert not m.depends_on("fusion", {"nonexistent"})
+
+
+def test_classify_fabrics_on_hybrid_mesh():
+    m = parse_hlo(GOLDEN)
+    cols = classify(m, MESH_2x4)
+    by_name = {c.name: c for c in cols}
+    assert by_name["ar.0"].axes == frozenset({"ici"})
+    assert by_name["ar.scalar"].axes == frozenset({"dcn"})
+    assert by_name["ar.scalar"].is_scalar
+    assert by_name["cp.0"].axes == frozenset({"ici"})
+    assert by_name["ag-start"].axes == frozenset({"ici"})
+    assert by_name["ar.0"].crosses("ici")
+    assert not by_name["ar.0"].crosses("dcn")
+    assert len(ring_permutes_over(cols, "ici")) == 1
+    assert ring_permutes_over(cols, "dcn") == []
+    assert [c.name for c in monolithic_over(cols, "ici")] == ["ag-start"]
+    assert [c.name for c in nonscalar_all_reduces(cols)] == ["ar.0"]
+
+
+def test_unknown_device_ids_classify_as_unknown():
+    tiny = MeshModel(
+        axis_names=("data",), shape=(2,), coords={0: (0,), 1: (1,)},
+    )
+    m = parse_hlo(GOLDEN)
+    by_name = {c.name: c for c in classify(m, tiny)}
+    assert by_name["ar.0"].axes is None
+    # unknown membership conservatively answers True to crosses()
+    assert by_name["ar.0"].crosses("data")
+
+
+def test_text_level_helpers_match_legacy_semantics():
+    assert has_op_with_result(GOLDEN, "all-reduce", "f32[8]")
+    assert not has_op_with_result(GOLDEN, "all-reduce", "f32[9]")
+    # async tuple results match through the parenthesized form
+    assert has_op_with_result(GOLDEN, "all-gather", "f32[32]")
+    assert nonscalar_all_reduce_count(GOLDEN) == 1
+    c = collective_counts(GOLDEN)
+    assert c["all-reduce"] == 2 and c["collective-permute"] == 1
+    assert c["reduce-scatter"] == 0 and c["all-to-all"] == 0
+
+
+def test_empty_replica_groups_and_degenerate_modules():
+    # empty groups: XLA's printed form for ONE group of ALL devices —
+    # a world-spanning collective. It must classify as crossing every
+    # non-trivial mesh axis (hiding it would blind the fabric rules to
+    # exactly the traffic they forbid).
+    text = """\
+ENTRY %e (p: f32[4]) -> f32[4] {
+  %p = f32[4]{0} parameter(0)
+  ROOT %ar = f32[4]{0} all-reduce(f32[4]{0} %p), replica_groups={}, to_apply=%r
+}
+"""
+    m = parse_hlo(text)
+    assert m.instructions["ar"].replica_groups == ()
+    [c] = classify(m, MESH_2x4)
+    assert c.axes == frozenset({"dcn", "ici"})
+    assert c.crosses("dcn") and c.crosses("ici")
+    # the empty string parses to an empty module
+    empty = parse_hlo("")
+    assert empty.entry is None and empty.instructions == {}
+    assert empty.collectives() == []
+
+
+def test_unparseable_result_shape_stays_visible_to_nonscalar_rules():
+    """A collective whose result fails the shape grammar (empty
+    buffers) must NOT masquerade as scalar — it would vanish from every
+    non-scalar all-reduce rule."""
+    text = """\
+ENTRY %e (p: f32[4]) -> f32[4] {
+  %p = f32[4]{0} parameter(0)
+  ROOT %ar = f32[<=1024] all-reduce(f32[4]{0} %p), replica_groups={{0,1}}, to_apply=%r
+}
+"""
+    m = parse_hlo(text)
+    ar = m.instructions["ar"]
+    assert ar.buffers == ()  # the bounded-dynamic shape didn't parse
+    assert not ar.is_scalar  # ...but it must not count as scalar
+    [c] = classify(m, MESH_2x4)
+    assert [x.name for x in nonscalar_all_reduces([c])] == ["ar"]
+
+
+def test_parser_tolerates_unknown_attributes():
+    text = """\
+ENTRY %e (p: f32[4]) -> f32[4] {
+  %p = f32[4]{0} parameter(0), sharding={replicated}, frontend_attributes={x="y"}
+  ROOT %cp = f32[4]{0} collective-permute(f32[4]{0} %p), channel_id=9, source_target_pairs={{0,1},{1,0}}, unknown_attr={weird}
+}
+"""
+    m = parse_hlo(text)
+    assert m.instructions["cp"].source_target_pairs == ((0, 1), (1, 0))
+    assert m.instructions["cp"].channel_id == 9

@@ -1,0 +1,546 @@
+"""Per-rule positive/negative tests for the collective-contract
+registry (`analysis/rules.py`): every rule is exercised on canned HLO
+(and canned LintTargets) with one case where the contract is VIOLATED
+(the rule must fire) and one where it holds (the rule must stay
+silent). The conftest meta-check walks the `hlo_rule(<id>, <polarity>)`
+markers and fails collection if a registered rule is missing either
+polarity — a rule nobody can trip is a rule nobody can trust.
+
+No lowering here: synthetic modules keep these tier-1 fast. The live
+negatives (real engines lint clean) are tests/test_hlolint.py."""
+
+import pytest
+
+from distributed_model_parallel_tpu.analysis.collectives import MeshModel
+from distributed_model_parallel_tpu.analysis.rules import (
+    LintContext,
+    LintTarget,
+    REGISTRY,
+)
+
+MESH8 = MeshModel(
+    axis_names=("data",), shape=(8,), coords={d: (d,) for d in range(8)}
+)
+MESH_2x4 = MeshModel(
+    axis_names=("dcn", "ici"), shape=(2, 4),
+    coords={d: (d // 4, d % 4) for d in range(8)},
+)
+MESH_M4 = MeshModel(
+    axis_names=("model",), shape=(4,), coords={d: (d,) for d in range(4)}
+)
+
+ICI_PAIRS = "{0,1},{1,2},{2,3},{3,0},{4,5},{5,6},{6,7},{7,4}"
+DATA_PAIRS = "{0,1},{1,2},{2,3},{3,4},{4,5},{5,6},{6,7},{7,0}"
+ICI_GROUPS = "{{0,1,2,3},{4,5,6,7}}"
+DCN_GROUPS = "{{0,4},{1,5},{2,6},{3,7}}"
+M4_PAIRS = "{0,1},{1,2},{2,3},{3,0}"
+
+
+def module(body_lines, header_extra="", params=("p: f32[64]",)):
+    """Wrap instruction lines into a minimal parseable module."""
+    plist = ", ".join(params)
+    body = ["  %{} = {} parameter({})".format(
+        p.split(":")[0], p.split(": ")[1] + "{0}", i
+    ) for i, p in enumerate(params)]
+    body += ["  " + ln.strip() for ln in body_lines]
+    body.append("  ROOT %ret = f32[] constant(0)")
+    return (
+        "HloModule m" + header_extra + "\n\n"
+        + "ENTRY %main (" + plist + ") -> f32[] {\n"
+        + "\n".join(body) + "\n}\n"
+    )
+
+
+def perm(name, operand, pairs, shape="f32[16]", tag=None):
+    meta = (
+        ', metadata={op_name="jit(f)/%s/ppermute"}' % tag if tag else ""
+    )
+    return (
+        "%{n} = {s}{{0}} collective-permute({s}{{0}} %{o}), "
+        "source_target_pairs={{{p}}}{m}".format(
+            n=name, s=shape, o=operand, p=pairs, m=meta
+        )
+    )
+
+
+def allreduce(name, operand, groups, shape="f32[16]", tag=None):
+    meta = (
+        ', metadata={op_name="jit(f)/%s/psum"}' % tag if tag else ""
+    )
+    return (
+        "%{n} = {s}{{0}} all-reduce({s}{{0}} %{o}), "
+        "replica_groups={g}, use_global_device_ids=true{m}".format(
+            n=name, s=shape, o=operand, g=groups, m=meta
+        )
+    )
+
+
+def check(rule_id, target, hlo, mesh):
+    rule = REGISTRY[rule_id]
+    assert rule.applies(target), (
+        f"{rule_id} should apply to this target"
+    )
+    return rule.check(LintContext.build(target, hlo, mesh))
+
+
+def hybrid_reducer_target(**kw):
+    base = dict(
+        name="t", engine="ddp", grad_reduction="bucketed",
+        data_axes=("dcn", "ici"), ici_axis="ici", dcn_axis="dcn",
+        ici_size=4, dcn_size=2,
+        bucket_plans=(((64, "f32"),),),  # one 64-elem padded bucket
+    )
+    base.update(kw)
+    return LintTarget(**base)
+
+
+def plain_reducer_target(**kw):
+    base = dict(
+        name="t", engine="ddp", grad_reduction="bucketed",
+        data_axes=("data",), ici_axis="data", ici_size=8,
+        bucket_plans=(((64, "f32"),),),
+    )
+    base.update(kw)
+    return LintTarget(**base)
+
+
+# ------------------------------------------------ dcn-grad-all-reduce
+
+
+@pytest.mark.hlo_rule("dcn-grad-all-reduce", "positive")
+def test_dcn_grad_all_reduce_fires_on_full_bucket_over_dcn():
+    # 64-elem f32 over 'dcn' = 256 B > the 16-elem (64 B) 1/ici shard.
+    hlo = module([allreduce("ar", "p", DCN_GROUPS, shape="f32[64]")])
+    found = check(
+        "dcn-grad-all-reduce", hybrid_reducer_target(), hlo, MESH_2x4
+    )
+    assert found and "crosses 'dcn'" in found[0].message
+
+
+@pytest.mark.hlo_rule("dcn-grad-all-reduce", "negative")
+def test_dcn_grad_all_reduce_allows_shard_sized_hop():
+    hlo = module([allreduce("ar", "p", DCN_GROUPS, shape="f32[16]")])
+    assert check(
+        "dcn-grad-all-reduce", hybrid_reducer_target(), hlo, MESH_2x4
+    ) == []
+
+
+# ------------------------------------------------ bucket-ring-permutes
+
+
+@pytest.mark.hlo_rule("bucket-ring-permutes", "positive")
+def test_bucket_ring_permutes_fires_on_missing_hop():
+    # expected 2*(4-1)*1 = 6 ici permutes; provide 5.
+    lines = [perm(f"cp{i}", "p", ICI_PAIRS) for i in range(5)]
+    found = check(
+        "bucket-ring-permutes", hybrid_reducer_target(), module(lines),
+        MESH_2x4,
+    )
+    assert found and "expected 2*(4-1)*1 = 6" in found[0].message
+
+
+@pytest.mark.hlo_rule("bucket-ring-permutes", "negative")
+def test_bucket_ring_permutes_exact_count_is_clean():
+    lines = [perm(f"cp{i}", "p", ICI_PAIRS) for i in range(6)]
+    # a 'dcn'-crossing permute must NOT count toward the ici rings
+    lines.append(perm("cpx", "p", "{0,4},{4,0}"))
+    assert check(
+        "bucket-ring-permutes", hybrid_reducer_target(), module(lines),
+        MESH_2x4,
+    ) == []
+
+
+# ---------------------------------------------- dcn-bucket-psum-shard
+
+
+@pytest.mark.hlo_rule("dcn-bucket-psum-shard", "positive")
+def test_dcn_bucket_psum_shard_fires_on_wrong_shape():
+    hlo = module([allreduce("ar", "p", DCN_GROUPS, shape="f32[64]")])
+    found = check(
+        "dcn-bucket-psum-shard", hybrid_reducer_target(), hlo, MESH_2x4
+    )
+    assert found and "1/ici shards" in found[0].message
+
+
+@pytest.mark.hlo_rule("dcn-bucket-psum-shard", "negative")
+def test_dcn_bucket_psum_shard_pinned_shape_is_clean():
+    hlo = module([allreduce("ar", "p", DCN_GROUPS, shape="f32[16]")])
+    assert check(
+        "dcn-bucket-psum-shard", hybrid_reducer_target(), hlo, MESH_2x4
+    ) == []
+
+
+# -------------------------------------------------- no-grad-all-reduce
+
+
+@pytest.mark.hlo_rule("no-grad-all-reduce", "positive")
+def test_no_grad_all_reduce_fires_on_fused_grad_reduction():
+    hlo = module(
+        [allreduce("ar", "p", "{{0,1,2,3,4,5,6,7}}", shape="f32[100]")]
+    )
+    found = check(
+        "no-grad-all-reduce",
+        plain_reducer_target(state_leaf_shapes=((16,),)), hlo, MESH8,
+    )
+    assert found and "grad-sized" in found[0].message
+
+
+@pytest.mark.hlo_rule("no-grad-all-reduce", "negative")
+def test_no_grad_all_reduce_allows_bn_stats_and_scalars():
+    hlo = module([
+        allreduce("bn", "p", "{{0,1,2,3,4,5,6,7}}", shape="f32[16]"),
+        allreduce("m", "p", "{{0,1,2,3,4,5,6,7}}", shape="f32[]"),
+    ])
+    assert check(
+        "no-grad-all-reduce",
+        plain_reducer_target(state_leaf_shapes=((16,),)), hlo, MESH8,
+    ) == []
+
+
+def test_no_grad_all_reduce_fused_tuple_cannot_smuggle_over_dcn():
+    """A combiner-fused tuple all-reduce whose FIRST buffer matches a
+    pinned 1/ici bucket shard must still fire when any OTHER buffer is
+    grad-sized — every buffer is checked against the allowlist."""
+    hlo = module(
+        [
+            "%art = (f32[16]{0}, f32[100]{0}) all-reduce(f32[16]{0} %p, "
+            "f32[100]{0} %p2), replica_groups=" + DCN_GROUPS
+            + ", use_global_device_ids=true",
+        ],
+        params=("p: f32[16]", "p2: f32[100]"),
+    )
+    found = check(
+        "no-grad-all-reduce", hybrid_reducer_target(), hlo, MESH_2x4
+    )
+    assert found and "grad-sized" in found[0].message
+
+
+# -------------------------------------------------- cm-ring-permutes
+
+
+def cm_op_target(**kw):
+    base = dict(
+        name="t", engine="cm_ag", data_axes=(), ici_axis=None,
+        ici_size=1, cm_axis="model", cm_size=4, expected_permutes=3,
+    )
+    base.update(kw)
+    return LintTarget(**base)
+
+
+@pytest.mark.hlo_rule("cm-ring-permutes", "positive")
+def test_cm_ring_permutes_fires_on_short_chain():
+    lines = [perm(f"cp{i}", "p", M4_PAIRS) for i in range(2)]
+    found = check("cm-ring-permutes", cm_op_target(), module(lines),
+                  MESH_M4)
+    assert found and "expected exactly 3" in found[0].message
+
+
+@pytest.mark.hlo_rule("cm-ring-permutes", "negative")
+def test_cm_ring_permutes_s_minus_1_is_clean():
+    lines = [perm(f"cp{i}", "p", M4_PAIRS) for i in range(3)]
+    assert check(
+        "cm-ring-permutes", cm_op_target(), module(lines), MESH_M4
+    ) == []
+
+
+# ------------------------------------------- cm-monolithic-collective
+
+
+@pytest.mark.hlo_rule("cm-monolithic-collective", "positive")
+def test_cm_monolithic_fires_on_surviving_all_gather():
+    hlo = module([
+        perm("cp0", "p", M4_PAIRS),
+        "%ag = f32[64]{0} all-gather(f32[64]{0} %p), "
+        "replica_groups={{0,1,2,3}}, dimensions={0}, "
+        "use_global_device_ids=true",
+    ])
+    found = check(
+        "cm-monolithic-collective", cm_op_target(), hlo, MESH_M4
+    )
+    assert found and "monolithic all-gather" in found[0].message
+
+
+@pytest.mark.hlo_rule("cm-monolithic-collective", "negative")
+def test_cm_monolithic_permute_only_kernel_is_clean():
+    lines = [perm(f"cp{i}", "p", M4_PAIRS) for i in range(3)]
+    assert check(
+        "cm-monolithic-collective", cm_op_target(), module(lines),
+        MESH_M4,
+    ) == []
+
+
+# --------------------------------------------------- fsdp-at-rest-sharded
+
+
+def fsdp_target(**kw):
+    base = dict(
+        name="t", engine="fsdp", data_axes=("data",), ici_axis="data",
+        ici_size=8, fsdp_full_leaf_shapes=((128, 128),),
+    )
+    base.update(kw)
+    return LintTarget(**base)
+
+
+@pytest.mark.hlo_rule("fsdp-at-rest-sharded", "positive")
+def test_fsdp_at_rest_fires_on_full_leaf_at_rest():
+    hlo = module([], params=("p: f32[128,128]",))
+    found = check("fsdp-at-rest-sharded", fsdp_target(), hlo, MESH8)
+    assert found and "materialized at rest" in found[0].message
+
+
+@pytest.mark.hlo_rule("fsdp-at-rest-sharded", "negative")
+def test_fsdp_at_rest_sharded_entry_is_clean():
+    hlo = module([], params=("p: f32[16,128]",))
+    assert check("fsdp-at-rest-sharded", fsdp_target(), hlo, MESH8) == []
+
+
+def test_fsdp_at_rest_vacuous_policy_is_a_finding():
+    """A model/mesh where the policy shards nothing must surface, not
+    silently pass."""
+    hlo = module([], params=("p: f32[16,128]",))
+    found = check(
+        "fsdp-at-rest-sharded", fsdp_target(fsdp_full_leaf_shapes=()),
+        hlo, MESH8,
+    )
+    assert found and "vacuous" in found[0].message
+
+
+# ---------------------------------------------- overlap-first-bucket-free
+
+
+def overlap_target(**kw):
+    base = dict(
+        name="t", engine="ddp", grad_reduction="overlapped",
+        data_axes=("data",), ici_axis="data", ici_size=8,
+        overlap_segments=2, bucket_plans=(((64, "f32"),), ((64, "f32"),)),
+    )
+    base.update(kw)
+    return LintTarget(**base)
+
+
+def overlap_module(first_operand):
+    """bwd_stage1 -> grad_reduce_stage1 permute (first-fired, operand
+    configurable) and bwd_stage0 -> grad_reduce_stage0 permute (the
+    positive control)."""
+    return module([
+        '%b1 = f32[16]{0} negate(f32[16]{0} %p), '
+        'metadata={op_name="jit(f)/bwd_stage1/neg"}',
+        perm("g1", first_operand, DATA_PAIRS, tag="grad_reduce_stage1"),
+        '%b0 = f32[16]{0} negate(f32[16]{0} %b1), '
+        'metadata={op_name="jit(f)/bwd_stage0/neg"}',
+        perm("g0", "b0", DATA_PAIRS, tag="grad_reduce_stage0"),
+    ])
+
+
+@pytest.mark.hlo_rule("overlap-first-bucket-free", "positive")
+def test_overlap_first_bucket_fires_on_serialized_firing():
+    # the first-fired bucket's permute consumes stage-0 backward output
+    found = check(
+        "overlap-first-bucket-free", overlap_target(),
+        overlap_module("b0"), MESH8,
+    )
+    assert found and "serialized" in found[0].message
+
+
+@pytest.mark.hlo_rule("overlap-first-bucket-free", "negative")
+def test_overlap_first_bucket_independent_is_clean():
+    assert check(
+        "overlap-first-bucket-free", overlap_target(),
+        overlap_module("b1"), MESH8,
+    ) == []
+
+
+def test_overlap_missing_tags_is_a_finding():
+    """Renamed scopes must fail loudly, not let the pin rot."""
+    hlo = module([perm("cp", "p", DATA_PAIRS)])
+    found = check(
+        "overlap-first-bucket-free", overlap_target(), hlo, MESH8
+    )
+    assert found and any("tags moved" in f.message for f in found)
+
+
+# ------------------------------------------------- prefetch-gather-free
+
+
+def fsdp_overlap_target(**kw):
+    base = dict(
+        name="t", engine="fsdp", grad_reduction="overlapped",
+        data_axes=("data",), ici_axis="data", ici_size=8,
+        overlap_segments=2, bucket_plans=(((64, "f32"),), ((64, "f32"),)),
+        fsdp_full_leaf_shapes=((128, 128),),
+    )
+    base.update(kw)
+    return LintTarget(**base)
+
+
+def prefetch_module(gather_operand):
+    return module([
+        perm("r1", "p", DATA_PAIRS, tag="grad_reduce_stage1"),
+        perm("r0", "p", DATA_PAIRS, tag="grad_reduce_stage0"),
+        "%pg = f32[128]{0} all-gather(f32[16]{0} %" + gather_operand
+        + "), replica_groups={{0,1,2,3,4,5,6,7}}, dimensions={0}, "
+        'use_global_device_ids=true, metadata={op_name='
+        '"jit(f)/prefetch_gather_stage0/all_gather"}',
+    ], params=("p: f32[16]",))
+
+
+@pytest.mark.hlo_rule("prefetch-gather-free", "positive")
+def test_prefetch_gather_fires_when_fed_by_reduction():
+    found = check(
+        "prefetch-gather-free", fsdp_overlap_target(),
+        prefetch_module("r1"), MESH8,
+    )
+    assert found and "overlap serialized" in found[0].message
+
+
+@pytest.mark.hlo_rule("prefetch-gather-free", "negative")
+def test_prefetch_gather_from_shards_is_clean():
+    assert check(
+        "prefetch-gather-free", fsdp_overlap_target(),
+        prefetch_module("p"), MESH8,
+    ) == []
+
+
+# --------------------------------------------------- bf16-ring-upcast
+
+
+def bf16_target(**kw):
+    base = dict(
+        name="t", engine="tp", collective_matmul=True, bf16=True,
+        cm_axis="model", cm_size=4, cm_min_ring_permutes=0,
+        data_axes=("data",), ici_axis="data", ici_size=2,
+        ring_dtypes=(
+            (("model",), "bf16", "jvp(ag_matmul)"),
+            (("data",), "f32", "jvp(bucket_ring)"),
+        ),
+    )
+    base.update(kw)
+    return LintTarget(**base)
+
+
+@pytest.mark.hlo_rule("bf16-ring-upcast", "positive")
+def test_bf16_ring_upcast_fires_on_f32_cm_ring():
+    found = check(
+        "bf16-ring-upcast",
+        bf16_target(ring_dtypes=((("model",), "f32", "jvp(ag_matmul)"),)),
+        module([]), MESH8,
+    )
+    assert found and "silent upcast" in found[0].message
+
+
+@pytest.mark.hlo_rule("bf16-ring-upcast", "negative")
+def test_bf16_ring_upcast_bf16_rings_clean_f32_buckets_allowed():
+    # grad-bucket rings over the data axis legitimately stay f32
+    # (f32 master params); only the cm axis is pinned.
+    assert check(
+        "bf16-ring-upcast", bf16_target(), module([]), MESH8
+    ) == []
+
+
+def test_bf16_ring_upcast_exempts_the_kv_ring_scope():
+    """The deliberately-f32 KV wire (accumulate-in-f32 contract,
+    ops/ring_attention.py) is a named-scope exemption, not a finding —
+    forward AND its transposed backward permutes."""
+    assert check(
+        "bf16-ring-upcast",
+        bf16_target(
+            cm_axis="seq",
+            ring_dtypes=(
+                (("seq",), "f32", "jvp(kv_ring)"),
+                (("seq",), "f32", "transpose(jvp(kv_ring))"),
+                (("seq",), "bf16", "jvp(ag_matmul)"),
+            ),
+        ),
+        module([]), MESH8,
+    ) == []
+
+
+def test_bf16_ring_upcast_exemption_is_whole_word_not_substring():
+    """A scope merely CONTAINING an exempt name (qkv_ring,
+    kv_ring_cache) must not inherit the exemption."""
+    found = check(
+        "bf16-ring-upcast",
+        bf16_target(ring_dtypes=(
+            (("model",), "f32", "jvp(qkv_ring)"),
+            (("model",), "f32", "jvp(kv_ring_cache)"),
+        )),
+        module([]), MESH8,
+    )
+    assert len(found) == 2
+
+
+def test_bf16_ring_upcast_requires_jaxpr_data():
+    found = check(
+        "bf16-ring-upcast", bf16_target(ring_dtypes=()), module([]),
+        MESH8,
+    )
+    assert found and "not checked" in found[0].message
+
+
+# ------------------------------------------------- donated-step-aliased
+
+
+@pytest.mark.hlo_rule("donated-step-aliased", "positive")
+def test_donated_step_fires_without_alias_table():
+    t = LintTarget(name="t", engine="ddp", donate=True, n_param_leaves=3)
+    found = check("donated-step-aliased", t, module([]), MESH8)
+    assert found and "double-buffered" in found[0].message
+
+
+@pytest.mark.hlo_rule("donated-step-aliased", "negative")
+def test_donated_step_with_alias_table_is_clean():
+    t = LintTarget(name="t", engine="ddp", donate=True, n_param_leaves=3)
+    hlo = module(
+        [],
+        header_extra=(
+            ", input_output_alias={ {0}: (0, {}, may-alias), "
+            "{1}: (1, {}, may-alias), {2}: (2, {}, may-alias) }"
+        ),
+    )
+    assert check("donated-step-aliased", t, hlo, MESH8) == []
+
+
+# --------------------------------------------- collective-fabric-known
+
+
+@pytest.mark.hlo_rule("collective-fabric-known", "positive")
+def test_fabric_known_fires_on_unresolvable_ids():
+    hlo = module([allreduce("ar", "p", "{{0,9}}", shape="f32[16]")])
+    t = LintTarget(name="t", engine="ddp")
+    found = check("collective-fabric-known", t, hlo, MESH8)
+    assert found and "does not resolve" in found[0].message
+
+
+@pytest.mark.hlo_rule("collective-fabric-known", "negative")
+def test_fabric_known_resolvable_ids_clean():
+    hlo = module([allreduce("ar", "p", ICI_GROUPS, shape="f32[16]")])
+    t = LintTarget(name="t", engine="ddp")
+    assert check("collective-fabric-known", t, hlo, MESH8) == []
+
+
+# ------------------------------------------------------ registry meta
+
+
+def test_registry_shape():
+    """>= 8 severity-tagged rules, each with contract + source + a
+    callable applicability predicate (the acceptance-criteria floor)."""
+    assert len(REGISTRY) >= 8
+    for r in REGISTRY.values():
+        assert r.severity in ("error", "warn")
+        assert r.contract and r.source
+        assert callable(r.applies) and callable(r.check)
+
+
+def test_exemptions_report_but_do_not_count():
+    from distributed_model_parallel_tpu.analysis.rules import run_rules
+
+    t = LintTarget(
+        name="t", engine="ddp", donate=True, n_param_leaves=3,
+        exemptions={
+            "donated-step-aliased": "intentional: lowering-only probe"
+        },
+    )
+    ctx = LintContext.build(t, module([]), MESH8)
+    found = [f for f in run_rules(ctx) if f.rule == "donated-step-aliased"]
+    assert found and found[0].exempted
+    assert "lowering-only" in found[0].exemption_reason

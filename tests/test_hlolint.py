@@ -1,0 +1,113 @@
+"""Engine-matrix lint gate: every supported engine x mode combo lowers
+on a virtual mesh and must pass the collective-contract registry
+(`analysis/lint.py`) — a future engine change that breaks a contract
+fails here with a NAMED rule, not as a silent perf regression.
+
+Tier-1 runs a representative subset (one combo per rule family:
+overlapped rings + BN allowlist, hybrid dcn pins, ZeRO overlap deps,
+bf16 cm rings, op-level S-1 kernels); the full S in {2,4,8} x mode x
+hybrid matrix — the `tools/hlolint` default — is the slow sweep."""
+
+import json
+
+import pytest
+
+from distributed_model_parallel_tpu.analysis.lint import (
+    Combo,
+    full_matrix,
+    lint_combo,
+    pregate_matrix,
+    run,
+)
+
+# One combo per rule family — the tier-1 cut of the matrix.
+TIER1_COMBOS = [
+    # rings + overlap deps + BatchNorm state allowlist (the pre-gate
+    # twin: tools/tier1.sh lints this exact combo before the suite)
+    Combo("ddp", 8, grad_reduction="overlapped", model="tinycnn"),
+    # ZeRO overlap: prefetch-gather freedom + at-rest sharding
+    Combo("fsdp", 4, grad_reduction="overlapped"),
+    # hybrid fabric: per-bucket dcn shard pins
+    Combo("ddp", 4, grad_reduction="bucketed", dcn=2),
+    # opted-in rings under mixed precision (jaxpr dtype contract)
+    Combo("tp", 4, collective_matmul=True, bf16=True),
+    # op-level exact S-1 kernels
+    Combo("cm_ag", 4),
+    Combo("cm_rs", 4),
+]
+
+
+def _assert_clean(rep):
+    assert rep.errors == [], (
+        f"{rep.combo.name}: "
+        + "; ".join(f"{f.rule}: {f.message}" for f in rep.errors)
+    )
+
+
+@pytest.mark.parametrize(
+    "combo", TIER1_COMBOS, ids=lambda c: c.name.replace("/", "-")
+)
+def test_tier1_matrix_combo_lints_clean(combo):
+    _assert_clean(lint_combo(combo))
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize(
+    "combo",
+    [c for c in full_matrix() if c not in TIER1_COMBOS],
+    ids=lambda c: c.name.replace("/", "-"),
+)
+def test_full_matrix_combo_lints_clean(combo):
+    """Full engine x mode x mesh sweep (S in {2,4,8} + 2x(S/2) hybrids
+    + bf16 + cm on/off) — the `tools/hlolint` default matrix. Tier-1
+    twin: test_tier1_matrix_combo_lints_clean keeps one combo per rule
+    family in the default run."""
+    _assert_clean(lint_combo(combo))
+
+
+def test_pregate_matrix_is_a_subset_of_the_full_matrix():
+    full = {c.name for c in full_matrix()}
+    for c in pregate_matrix():
+        assert c.name in full
+
+
+def test_runner_emits_partial_json_per_combo():
+    """The established partial-JSON convention: one {"leg": ...,
+    "partial": true} line per finished combo, then a final summary
+    object with the violation/rule counts (the bench dryrun's hlo_lint
+    leg consumes the same API)."""
+    lines = []
+    summary = run([Combo("cm_ag", 2)], emit=lines.append)
+    parsed = [json.loads(x) for x in lines if x.startswith("{")]
+    legs = [p for p in parsed if p.get("partial")]
+    assert len(legs) == 1
+    assert legs[0]["leg"]["name"] == "cm_ag/S2"
+    assert legs[0]["leg"]["violations"] == 0
+    final = [p for p in parsed if "hlo_lint" in p]
+    assert len(final) == 1
+    assert final[0]["hlo_lint"] == summary["hlo_lint"]
+    assert summary["hlo_lint"]["errors"] == 0
+    assert summary["hlo_lint"]["rules"] >= 8
+
+
+def test_lowering_failure_counts_as_an_error():
+    """A combo that fails to LOWER must drive a nonzero error count
+    (and thus the CLI's exit status) — an engine regression that
+    crashes lowering may not sail through the gates as 'no findings'."""
+    lines = []
+    summary = run([Combo("no-such-engine", 2)], emit=lines.append)
+    assert summary["hlo_lint"]["lowered"] == 0
+    assert summary["hlo_lint"]["errors"] == 1
+    assert summary["hlo_lint"]["failed_targets"] == ["no-such-engine/S2"]
+    legs = [json.loads(x) for x in lines if x.startswith("{")]
+    assert any("error" in p.get("leg", {}) for p in legs
+               if isinstance(p.get("leg"), dict))
+
+
+def test_cli_list_rules_runs_without_backend(capsys):
+    from distributed_model_parallel_tpu.analysis.lint import main
+
+    assert main(["--list-rules"]) == 0
+    out = capsys.readouterr().out
+    assert "bucket-ring-permutes" in out
+    assert "error" in out

@@ -17,6 +17,10 @@
 #     in seconds with the module named (exit 2), instead of surfacing
 #     mid-run; the main pass still carries
 #     --continue-on-collection-errors as a belt-and-braces backstop,
+#   * an `hlolint` PRE-GATE (tools/hlolint --pregate, exit 3): the
+#     collective-contract linter over tinycnn DDP/FSDP overlapped, so a
+#     broken ring/fabric/overlap contract fails in seconds with the
+#     violated rule named (INTERNALS.md section 8b has the catalog),
 #   * 870 s budget with a hard kill 10 s later,
 #   * DOTS_PASSED=<n> printed from the progress dots as a
 #     tamper-resistant pass count (parsed from the tee'd log, not from
@@ -49,6 +53,26 @@ if ! timeout -k 5 240 env JAX_PLATFORMS=cpu \
 fi
 echo "[tier1] collection ok:" \
   "$(grep -cE '::' /tmp/_t1_collect.log || true) tests collected"
+
+# hlolint pre-gate (mirrors the --collect-only pre-gate): lint the two
+# deepest-rule-stack combos (tinycnn DDP + FSDP overlapped — rings,
+# overlap deps, BN allowlist, at-rest sharding) BEFORE the suite, so a
+# broken collective contract fails in seconds with the violated rule
+# NAMED instead of as a slow structural-test failure mid-run. Exit 3
+# distinguishes a contract violation from a collection failure (2).
+rm -f /tmp/_t1_hlolint.log
+if ! timeout -k 5 300 bash tools/hlolint --pregate \
+    > /tmp/_t1_hlolint.log 2>&1; then
+  echo "[tier1] HLOLINT PRE-GATE FAILED — a collective contract is" \
+    "violated (tools/hlolint, INTERNALS.md section 8b):"
+  grep -aE "ERROR|WARN|LOWERING FAILED|hlo_lint" /tmp/_t1_hlolint.log \
+    | head -20
+  echo DOTS_PASSED=0
+  exit 3
+fi
+echo "[tier1] hlolint pre-gate ok:" \
+  "$(grep -ac '"partial": true' /tmp/_t1_hlolint.log || true)" \
+  "combo(s) lint clean"
 
 rm -f /tmp/_t1.log
 timeout -k 10 870 env JAX_PLATFORMS=cpu \
