@@ -921,6 +921,151 @@ def run_child_reducer(max_devices: int, platform: str = "cpu") -> None:
     print(json.dumps(out, indent=2))
 
 
+def run_child_serving(max_devices: int, platform: str = "cpu") -> None:
+    """Serving microbench (`serving/engine.py`) — tokens/sec and
+    p50/p99 per-token latency, prefill vs decode legs, per cache
+    layout.
+
+    For each layout the device count hosts (replicated; tp at S with
+    the declarative lowering AND the opted-in decode rings; sp at S),
+    times the two serving legs separately on a small GPT:
+
+      * prefill — K single-request prompt ingests (the padded-prompt
+        compile), per-call p50/p99 and prompt-tokens/sec;
+      * decode  — N full-batch mixed-position token steps with every
+        slot active, per-step p50/p99 and generated-tokens/sec.
+
+    Emits one partial JSON line per completed (layout, S) row — a
+    wedge mid-sweep keeps the finished rows — then the table.
+    Meaningful on a real slice; on virtual CPU devices the rings
+    serialize onto one core (the note in the JSON says so)."""
+    if max_devices < 1:
+        raise ValueError(f"--max-devices must be >= 1, got {max_devices}")
+    if platform == "cpu":
+        from distributed_model_parallel_tpu.runtime.platform import force_cpu
+
+        force_cpu(max(max_devices, 1))
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from distributed_model_parallel_tpu.models.gpt import GPTConfig
+    from distributed_model_parallel_tpu.runtime.mesh import (
+        MeshSpec,
+        make_mesh,
+    )
+    from distributed_model_parallel_tpu.serving.engine import ServingEngine
+
+    devices = jax.devices("cpu") if platform == "cpu" else jax.devices()
+    num_slots, p_len, max_len, new_steps, n_prefills = 8, 16, 64, 32, 8
+    cfg = GPTConfig(
+        vocab_size=128, dim=64, num_layers=2, num_heads=4, ffn_dim=128,
+        max_position=max_len, dropout_rate=0.0,
+    )
+    legs = [("replicated", 1, False)]
+    for s in (2, 4):
+        if s <= min(max_devices, len(devices)):
+            legs += [("tp", s, False), ("tp", s, True), ("sp", s, False)]
+    rng = np.random.RandomState(0)
+    prompt = rng.randint(1, cfg.vocab_size, size=p_len).astype(np.int32)
+
+    rows = []
+    for layout, size, cm in legs:
+        mesh = None
+        if layout != "replicated":
+            spec = MeshSpec(
+                data=1,
+                model=size if layout == "tp" else 1,
+                seq=size if layout == "sp" else 1,
+            )
+            mesh = make_mesh(spec, devices=devices[:size])
+        eng = ServingEngine(
+            cfg, mesh, layout=layout, num_slots=num_slots,
+            max_len=max_len, prefill_len=p_len, collective_matmul=cm,
+        )
+        params = eng.init_params(jax.random.PRNGKey(0))
+        ids, length = eng.pad_prompt(prompt)
+        tokens = jnp.zeros((num_slots,), jnp.int32)
+        active = jnp.ones((num_slots,), jnp.bool_)
+
+        # --- prefill leg: fill every slot once (slot 0 is the warmup
+        # compile), then re-ingest for the timed calls.
+        cache = eng.init_cache()
+        cache, nl = eng.prefill(params, cache, ids, length, jnp.int32(0))
+        jax.block_until_ready(nl)
+        for slot in range(1, num_slots):
+            cache, nl = eng.prefill(
+                params, cache, ids, length, jnp.int32(slot)
+            )
+        jax.block_until_ready(nl)
+        prefill_ms = []
+        for i in range(n_prefills):
+            t0 = time.perf_counter()
+            cache, nl = eng.prefill(
+                params, cache, ids, length, jnp.int32(i % num_slots)
+            )
+            jax.block_until_ready(nl)
+            prefill_ms.append((time.perf_counter() - t0) * 1e3)
+
+        # --- decode leg: every slot active at the prompt position.
+        cache, logits = eng.decode_step(params, cache, tokens, active)
+        jax.block_until_ready(logits)  # compile + warmup
+        decode_ms = []
+        for _ in range(new_steps):
+            t0 = time.perf_counter()
+            cache, logits = eng.decode_step(
+                params, cache, tokens, active
+            )
+            jax.block_until_ready(logits)
+            decode_ms.append((time.perf_counter() - t0) * 1e3)
+
+        pf, dc = np.asarray(prefill_ms), np.asarray(decode_ms)
+        row = {
+            "layout": layout + ("_cm" if cm else ""),
+            "axis_size": size,
+            "prefill_p50_ms": round(float(np.percentile(pf, 50)), 3),
+            "prefill_p99_ms": round(float(np.percentile(pf, 99)), 3),
+            "prefill_tokens_per_s": round(
+                p_len * len(pf) / (pf.sum() / 1e3), 1
+            ),
+            "decode_p50_ms": round(float(np.percentile(dc, 50)), 3),
+            "decode_p99_ms": round(float(np.percentile(dc, 99)), 3),
+            "decode_tokens_per_s": round(
+                num_slots * len(dc) / (dc.sum() / 1e3), 1
+            ),
+        }
+        rows.append(row)
+        log(f"{row['layout']} S={size}: prefill p50 "
+            f"{row['prefill_p50_ms']}ms, decode p50 "
+            f"{row['decode_p50_ms']}ms "
+            f"({row['decode_tokens_per_s']} tok/s)")
+        # Per-leg partial line (same convention as the other sweeps).
+        print(json.dumps({"leg": row, "partial": True}), flush=True)
+
+    out = {
+        "serving_microbench": rows,
+        "platform": jax.devices()[0].platform,
+        "device_kind": jax.devices()[0].device_kind,
+        "model": {
+            "dim": cfg.dim, "layers": cfg.num_layers,
+            "heads": cfg.num_heads, "vocab": cfg.vocab_size,
+        },
+        "num_slots": num_slots,
+        "prefill_len": p_len,
+        "max_len": max_len,
+    }
+    if jax.devices()[0].platform == "cpu":
+        out["note"] = (
+            "virtual CPU devices serialize the decode rings onto one "
+            "core, so the tp/sp layouts cannot win here; the harness "
+            "is meaningful on a real slice, where each ring hop's "
+            "transfer runs beside the chunk dot and the head-sharded "
+            "cache halves per-chip attention reads"
+        )
+    print(json.dumps(out, indent=2))
+
+
 # -------------------------------------------------------------- parent side
 
 
@@ -1301,6 +1446,14 @@ if __name__ == "__main__":
              "line; devices from --scaling-platform / --max-devices",
     )
     parser.add_argument(
+        "--serving-microbench", action="store_true",
+        help="print a per-layout serving table (tokens/sec + p50/p99 "
+             "per-token latency, prefill vs decode legs, over the "
+             "slot-paged KV cache — serving/engine.py) instead of the "
+             "single benchmark line; devices from --scaling-platform / "
+             "--max-devices",
+    )
+    parser.add_argument(
         "--child", action="store_true",
         help="internal: run a measurement in-process (spawned by main)",
     )
@@ -1316,6 +1469,9 @@ if __name__ == "__main__":
     parser.add_argument("--child-reducer", action="store_true",
                         help="internal: run the gradient-reduction "
                              "microbench in-process")
+    parser.add_argument("--child-serving", action="store_true",
+                        help="internal: run the serving microbench "
+                             "in-process")
     parser.add_argument("--child-model", default="mobilenetv2")
     parser.add_argument("--child-batch", type=int, default=512)
     parser.add_argument("--child-dtypes", default="bfloat16,float32")
@@ -1324,13 +1480,15 @@ if __name__ == "__main__":
     args = parser.parse_args()
 
     n_sweeps = sum(
-        (args.scaling, args.cm_microbench, args.reducer_microbench)
+        (args.scaling, args.cm_microbench, args.reducer_microbench,
+         args.serving_microbench)
     )
     if n_sweeps > 1:
         parser.error(
-            "--scaling / --cm-microbench / --reducer-microbench are "
-            "mutually exclusive (one sweep per invocation; running "
-            "several would silently drop tables)"
+            "--scaling / --cm-microbench / --reducer-microbench / "
+            "--serving-microbench are mutually exclusive (one sweep "
+            "per invocation; running several would silently drop "
+            "tables)"
         )
 
     if args.child_probe:
@@ -1349,6 +1507,9 @@ if __name__ == "__main__":
         sys.exit(0)
     if args.child_reducer:
         run_child_reducer(args.max_devices, args.scaling_platform)
+        sys.exit(0)
+    if args.child_serving:
+        run_child_serving(args.max_devices, args.scaling_platform)
         sys.exit(0)
 
     def on_alarm(signum, frame):
@@ -1383,12 +1544,19 @@ if __name__ == "__main__":
                      "--scaling-platform", args.scaling_platform],
                     env, "collective_matmul_microbench",
                 )
-            else:
+            elif args.reducer_microbench:
                 _run_sweep_child(
                     ["--child-reducer",
                      "--max-devices", str(args.max_devices),
                      "--scaling-platform", args.scaling_platform],
                     env, "reducer_microbench",
+                )
+            else:
+                _run_sweep_child(
+                    ["--child-serving",
+                     "--max-devices", str(args.max_devices),
+                     "--scaling-platform", args.scaling_platform],
+                    env, "serving_microbench",
                 )
         else:
             main()

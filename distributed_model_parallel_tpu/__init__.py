@@ -21,6 +21,8 @@ Package layout:
              Pallas flash kernel
   parallel/  DP / DDP / FSDP / pipeline / tensor-parallel /
              sequence-parallel / expert-parallel engines
+  serving/   autoregressive inference: slot-paged KV cache, continuous
+             batching, decode-time TP rings (INTERNALS.md §9)
   data/      dataset collection + per-host sharded, prefetching input
              pipeline
   training/  trainer loops, optimizer/schedule, metrics, checkpointing,

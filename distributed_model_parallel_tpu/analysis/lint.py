@@ -39,8 +39,8 @@ _DTYPE_TOKEN = {
 class Combo:
     """One cell of the engine x mode x mesh matrix. `size` is the
     engine's PRIMARY parallel axis: the data axis for dp/ddp/fsdp/sp_lm,
-    'model' for tp and the cm_* op kernels, 'seq' for sp, 'stage' for
-    pipeline."""
+    'model' for tp, serve, and the cm_* op kernels, 'seq' for sp,
+    'stage' for pipeline."""
 
     engine: str
     size: int
@@ -612,6 +612,66 @@ def _build_cm_op(combo: Combo, devices):
     return target, hlo, mesh
 
 
+def _build_serve(combo: Combo, devices):
+    """Serving decode-step targets (`serving/engine.py`, tp layout):
+    the jitted mixed-position token step over the slot-paged KV cache,
+    declarative or with the opted-in decode rings. The lint pins the
+    PR 7 contract: an opted-in step carries exactly 4*L*(S-1)
+    `serve_ring`-tagged permutes and no monolithic all-gather over
+    'model' (rule `serve-decode-ring`)."""
+    import jax
+    import jax.numpy as jnp
+
+    from distributed_model_parallel_tpu.models.gpt import GPTConfig
+    from distributed_model_parallel_tpu.runtime.mesh import (
+        MeshSpec, make_mesh,
+    )
+    from distributed_model_parallel_tpu.serving.decode import (
+        decode_ring_permutes,
+    )
+    from distributed_model_parallel_tpu.serving.engine import (
+        ServingEngine,
+    )
+
+    s = combo.size
+    mesh = make_mesh(MeshSpec(data=1, model=s), devices=devices[:s])
+    cfg = GPTConfig(
+        vocab_size=61, dim=16, num_layers=2, num_heads=4, ffn_dim=32,
+        max_position=16, dropout_rate=0.0,
+    )
+    eng = ServingEngine(
+        cfg, mesh, layout="tp", num_slots=2 * s, max_len=16,
+        prefill_len=8, collective_matmul=combo.collective_matmul,
+        compute_dtype=jnp.bfloat16 if combo.bf16 else None,
+    )
+    params = eng.init_params(jax.random.PRNGKey(0))
+    cache = eng.init_cache()
+    tokens = jnp.zeros((eng.num_slots,), jnp.int32)
+    active = jnp.ones((eng.num_slots,), jnp.bool_)
+    hlo = eng.decode_step.lower(
+        params, cache, tokens, active
+    ).compile().as_text()
+    expected = (
+        decode_ring_permutes(cfg.num_layers, s)
+        if combo.collective_matmul else None
+    )
+    target = LintTarget(
+        name=combo.name, engine="serve", donate=True, bf16=combo.bf16,
+        collective_matmul=combo.collective_matmul,
+        cm_axis="model" if combo.collective_matmul else None,
+        cm_size=s,
+        # Floor for the shared cm-ring-permutes rule (GSPMD adds its
+        # own resharding permutes on top); the exact tagged pin is
+        # serve-decode-ring's.
+        cm_min_ring_permutes=expected or 0,
+        serve_decode_permutes=expected,
+        # The decode step donates the 3 cache leaves (k, v, lengths).
+        n_param_leaves=3,
+        **_mesh_facts(mesh),
+    )
+    return target, hlo, mesh
+
+
 _BUILDERS: dict = {
     "dp": _build_data_engine,
     "ddp": _build_data_engine,
@@ -622,6 +682,7 @@ _BUILDERS: dict = {
     "pipeline": _build_pipeline,
     "cm_ag": _build_cm_op,
     "cm_rs": _build_cm_op,
+    "serve": _build_serve,
 }
 
 
@@ -647,8 +708,9 @@ def full_matrix() -> List[Combo]:
     """The engine x mode matrix the acceptance criteria name: every
     engine at S in {2,4,8} on its primary axis, DDP/FSDP/CausalLM-SP in
     all three reduction modes, collective_matmul off/on, hybrid
-    2 x (S/2) dcn x ici meshes for the reducer paths, plus the bf16
-    ring combos and the tinycnn (BatchNorm) pre-gate twins."""
+    2 x (S/2) dcn x ici meshes for the reducer paths, the serving
+    decode steps (declarative + opted-in rings), plus the bf16 ring
+    combos and the tinycnn (BatchNorm) pre-gate twins."""
     combos: List[Combo] = []
     for s in (2, 4, 8):
         combos += [Combo("cm_ag", s), Combo("cm_rs", s)]
@@ -669,6 +731,9 @@ def full_matrix() -> List[Combo]:
             combos.append(Combo("sp_lm", s, grad_reduction=gr))
     combos.append(Combo("sp_lm", 4, grad_reduction="bucketed", dcn=2))
     combos.append(Combo("sp_lm", 2, collective_matmul=True))
+    for s in (2, 4):  # serving decode step, declarative + opted-in
+        combos.append(Combo("serve", s))
+        combos.append(Combo("serve", s, collective_matmul=True))
     combos += [Combo("pipeline", 2), Combo("pipeline", 4)]
     combos.append(Combo("tp", 4, collective_matmul=True, bf16=True))
     combos.append(Combo("sp", 4, collective_matmul=True, bf16=True))

@@ -61,7 +61,8 @@ class LintTarget:
     tests can construct minimal targets."""
 
     name: str
-    # dp | ddp | fsdp | tp | sp | sp_lm | pipeline | cm_ag | cm_rs
+    # dp | ddp | fsdp | tp | sp | sp_lm | pipeline | serve | cm_ag |
+    # cm_rs
     engine: str
     grad_reduction: str = "monolithic"
     collective_matmul: bool = False
@@ -86,6 +87,10 @@ class LintTarget:
     # Collective-matmul expectations.
     expected_permutes: Optional[int] = None  # op-level exact pin
     cm_min_ring_permutes: int = 0  # engine-level floor
+    # Serving decode expectation (engine == "serve", opted-in rings):
+    # the exact `serve_ring`-tagged permute count of one decode step,
+    # 4 projection rings per block x (S-1) hops (PR 7).
+    serve_decode_permutes: Optional[int] = None
     # jaxpr metadata: ((axis_names, dtype_token, scope), ...) for every
     # `ppermute` equation in the traced step. Compiled CPU HLO cannot
     # carry dtype contracts (the backend's float-normalization pass
@@ -527,6 +532,46 @@ def _prefetch_gather_free(ctx: LintContext) -> List[Finding]:
                     "bucket reduction — the ZeRO overlap serialized",
                     g,
                 ))
+    return out
+
+
+@rule(
+    id="serve-decode-ring", severity="error", source="PR 7",
+    contract=(
+        "An opted-in serving decode step rides the chunked rings: "
+        "exactly 4*layers*(S-1) `serve_ring`-tagged collective-"
+        "permutes (one ag_matmul/matmul_rs ring per qkv / attn-out / "
+        "ffn-in / ffn-out projection, no backward) and ZERO monolithic "
+        "all-gather/reduce-scatter crossing the TP axis — the decode "
+        "projections never fall back to the partitioner's fused "
+        "collectives."
+    ),
+    applies=lambda t: t.engine == "serve" and t.collective_matmul,
+)
+def _serve_decode_ring(ctx: LintContext) -> List[Finding]:
+    t = ctx.target
+    out = []
+    if t.serve_decode_permutes is None:
+        return [ctx.finding(
+            "serve-decode-ring",
+            "no serve_decode_permutes expectation on an opted-in "
+            "serving combo — the ring pin was not checked",
+        )]
+    tagged = ctx.module.tagged("serve_ring", "collective-permute")
+    if len(tagged) != t.serve_decode_permutes:
+        out.append(ctx.finding(
+            "serve-decode-ring",
+            f"{len(tagged)} serve_ring-tagged permutes, expected "
+            f"exactly {t.serve_decode_permutes} (4 rings/block x "
+            "(S-1) hops)",
+        ))
+    for c in monolithic_over(ctx.collectives, t.cm_axis):
+        out.append(ctx.finding(
+            "serve-decode-ring",
+            f"{c.name}: monolithic {c.kind} crossing '{t.cm_axis}' on "
+            "an opted-in decode step",
+            c.name,
+        ))
     return out
 
 

@@ -434,6 +434,81 @@ def check_overlapped_model(name: str, overlap_stages: int = 0) -> None:
         )
 
 
+def check_serving_args(args) -> None:
+    """Startup-time validation of the serving CLI surface
+    (`cli/serve.py`), mirroring the other `check_*_args` guards: fail
+    with CLI vocabulary before meshes/engines are built, and reject
+    training-side flags that would silently do nothing on an
+    inference-only run.
+
+    The serve parser deliberately CARRIES the shared training flags
+    (`add_grad_reduction_flags`, --pipeline-stages) so a launch line
+    pasted from the lm CLI fails with an explanation here instead of an
+    opaque argparse error."""
+    if args.pipeline_stages != 1:
+        raise SystemExit(
+            "--pipeline-stages selects a TRAINING engine's stage wires; "
+            "serving decodes token-by-token through one replica's "
+            "layers (compose tp/sp layouts instead) — drop the flag"
+        )
+    if args.grad_reduction != "monolithic":
+        raise SystemExit(
+            "--grad-reduction configures the training engines' gradient "
+            "collective; serving runs no backward — drop the flag"
+        )
+    if args.bucket_mb is not None:
+        raise SystemExit(
+            "--bucket-mb sizes gradient-reduction buckets; serving runs "
+            "no backward — drop the flag"
+        )
+    if args.overlap_stages is not None:
+        raise SystemExit(
+            "--overlap-stages cuts the stagewise backward; serving runs "
+            "no backward — drop the flag"
+        )
+    if args.dcn_slices != 1:
+        raise SystemExit(
+            "--dcn-slices factors the data axis for gradient traffic; "
+            "the serving meshes are 'model'/'seq' only — drop the flag"
+        )
+    if args.layout == "tp":
+        if args.model_shards < 2:
+            raise SystemExit(
+                "--layout tp shards heads over the 'model' axis; "
+                "--model-shards must be >= 2 (1 shard IS the "
+                "replicated layout — use --layout replicated)"
+            )
+        if args.seq_shards != 1:
+            raise SystemExit(
+                "--seq-shards belongs to --layout sp; the tp layout "
+                "rings over 'model' — drop one of the flags"
+            )
+    elif args.layout == "sp":
+        if args.seq_shards < 2:
+            raise SystemExit(
+                "--layout sp shards cache positions over the 'seq' "
+                "axis; --seq-shards must be >= 2 (1 shard IS the "
+                "replicated layout — use --layout replicated)"
+            )
+        if args.model_shards != 1:
+            raise SystemExit(
+                "--model-shards belongs to --layout tp; the sp layout "
+                "shards over 'seq' — drop one of the flags"
+            )
+    else:  # replicated
+        if args.model_shards != 1 or args.seq_shards != 1:
+            raise SystemExit(
+                "--model-shards / --seq-shards select the tp / sp "
+                "layouts; pass --layout tp or --layout sp explicitly"
+            )
+    if args.collective_matmul and args.layout != "tp":
+        raise SystemExit(
+            "--collective-matmul rings decode projections over the "
+            "'model' axis; it requires --layout tp with "
+            "--model-shards >= 2"
+        )
+
+
 def compute_dtype_from_flag(name: str):
     """--dtype flag value -> engine compute_dtype (None = pure f32)."""
     import jax.numpy as jnp

@@ -1,0 +1,199 @@
+"""Offline serving driver — the inference-side fourth launcher.
+
+Feeds a synthetic request trace (random prompts, optionally staggered
+Poisson arrivals collapsed to submission order — this sandbox has no
+live traffic) through `serving.ServingEngine` under continuous
+batching, and reports per-request latencies plus the aggregate
+tokens/sec and p50/p99 per-token legs, as JSON on stdout.
+
+  python -m distributed_model_parallel_tpu.cli.serve \
+      --dim 128 --layers 4 --heads 4 --num-requests 32 \
+      --num-slots 8 --max-len 256 --prefill-len 64
+  python -m distributed_model_parallel_tpu.cli.serve \
+      --layout tp --model-shards 4 --collective-matmul
+  python -m distributed_model_parallel_tpu.cli.serve \
+      --layout sp --seq-shards 4 --max-len 512
+
+The parser carries the shared training flags (grad reduction, pipeline
+stages) so a pasted training launch line fails fast with an explanation
+(`cli/common.check_serving_args`) instead of silently doing nothing.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+
+import jax
+import numpy as np
+
+from distributed_model_parallel_tpu.cli.common import (
+    add_grad_reduction_flags,
+    check_serving_args,
+    compute_dtype_from_flag,
+)
+from distributed_model_parallel_tpu.models.gpt import GPTConfig
+from distributed_model_parallel_tpu.runtime.dist import initialize_backend
+from distributed_model_parallel_tpu.runtime.mesh import MeshSpec, make_mesh
+from distributed_model_parallel_tpu.serving.engine import ServingEngine
+from distributed_model_parallel_tpu.serving.scheduler import Request
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        description="offline autoregressive serving (continuous "
+                    "batching over a slot-paged KV cache)"
+    )
+    # Model (matches the lm CLI's surface; params init fresh — point a
+    # future --checkpoint at a trained canonical state to serve it).
+    p.add_argument("--vocab-size", default=256, type=int)
+    p.add_argument("--dim", default=128, type=int)
+    p.add_argument("--layers", default=4, type=int)
+    p.add_argument("--heads", default=4, type=int)
+    p.add_argument("--ffn-dim", default=None, type=int,
+                   help="default 4*dim")
+    p.add_argument("--dtype", default="float32",
+                   choices=("float32", "bfloat16"))
+    # Serving surface.
+    p.add_argument("--layout", default="replicated",
+                   choices=("replicated", "tp", "sp"),
+                   help="cache/param layout: replicated; tp = heads "
+                        "over 'model' (MEGATRON_RULES params); sp = "
+                        "cache positions over 'seq' (online-softmax "
+                        "decode, ring-attention prefill)")
+    p.add_argument("--model-shards", default=1, type=int,
+                   help="'model' mesh axis size (--layout tp)")
+    p.add_argument("--seq-shards", default=1, type=int,
+                   help="'seq' mesh axis size (--layout sp)")
+    p.add_argument("--collective-matmul", action="store_true",
+                   help="latency-hiding decode rings (tp layout): "
+                        "opted-in projections run as chunked ppermute "
+                        "rings over the slot batch — exactly "
+                        "4*layers*(S-1) permutes per decode step, no "
+                        "monolithic all-gather (hlolint "
+                        "serve-decode-ring)")
+    p.add_argument("--num-slots", default=8, type=int,
+                   help="KV-cache slots = max concurrent sequences")
+    p.add_argument("--max-len", default=256, type=int,
+                   help="cache positions per slot (prompt + generated)")
+    p.add_argument("--prefill-len", default=64, type=int,
+                   help="padded prompt length (one prefill compile)")
+    # Synthetic trace.
+    p.add_argument("--num-requests", default=16, type=int)
+    p.add_argument("--prompt-len-min", default=4, type=int)
+    p.add_argument("--prompt-len-max", default=32, type=int)
+    p.add_argument("--max-new-tokens", default=32, type=int)
+    p.add_argument("--seed", default=0, type=int)
+    # Shared training flags, carried so pasted launch lines fail fast
+    # with an explanation (check_serving_args) instead of an argparse
+    # unknown-flag error.
+    p.add_argument("--pipeline-stages", default=1, type=int,
+                   help="TRAINING flag; rejected here (serving has no "
+                        "stage wires)")
+    add_grad_reduction_flags(p)
+    return p
+
+
+def synthetic_trace(args) -> list:
+    """Deterministic random request set: prompt lengths uniform in
+    [min, max], token ids uniform over the vocabulary (0 is reserved
+    for padding)."""
+    rng = np.random.RandomState(args.seed)
+    out = []
+    for i in range(args.num_requests):
+        n = int(rng.randint(
+            args.prompt_len_min, args.prompt_len_max + 1
+        ))
+        out.append(Request(
+            rid=i,
+            prompt=rng.randint(
+                1, args.vocab_size, size=n
+            ).astype(np.int32),
+            max_new_tokens=args.max_new_tokens,
+        ))
+    return out
+
+
+def main(argv=None) -> dict:
+    args = build_parser().parse_args(argv)
+    check_serving_args(args)
+    if args.prompt_len_min < 1 or args.prompt_len_max < args.prompt_len_min:
+        raise SystemExit(
+            f"--prompt-len-min/max must satisfy 1 <= min <= max, got "
+            f"[{args.prompt_len_min}, {args.prompt_len_max}]"
+        )
+    if args.prompt_len_max > args.prefill_len:
+        raise SystemExit(
+            f"--prompt-len-max {args.prompt_len_max} exceeds "
+            f"--prefill-len {args.prefill_len}"
+        )
+    initialize_backend()
+    cfg = GPTConfig(
+        vocab_size=args.vocab_size,
+        dim=args.dim,
+        num_layers=args.layers,
+        num_heads=args.heads,
+        ffn_dim=args.ffn_dim or 4 * args.dim,
+        max_position=args.max_len,
+        dropout_rate=0.0,
+        pad_token_id=0,
+    )
+    shards = max(args.model_shards, args.seq_shards)
+    mesh = None
+    if args.layout != "replicated":
+        devices = jax.devices()
+        if shards > len(devices):
+            raise SystemExit(
+                f"{shards} shards requested but only {len(devices)} "
+                "devices present"
+            )
+        mesh = make_mesh(
+            MeshSpec(
+                data=1,
+                model=args.model_shards,
+                seq=args.seq_shards,
+            ),
+            devices=devices[:shards],
+        )
+    engine = ServingEngine(
+        cfg, mesh,
+        layout=args.layout,
+        num_slots=args.num_slots,
+        max_len=args.max_len,
+        prefill_len=args.prefill_len,
+        collective_matmul=args.collective_matmul,
+        compute_dtype=compute_dtype_from_flag(args.dtype),
+    )
+    params = engine.init_params(jax.random.PRNGKey(args.seed))
+    requests = synthetic_trace(args)
+    sched = engine.run(params, requests)
+    report = sched.latency_report()
+    per_request = [
+        {
+            "rid": f.rid,
+            "prompt_len": f.prompt_len,
+            "generated": len(f.tokens),
+            "prefill_ms": round(f.prefill_s * 1e3, 3),
+            "total_ms": round(f.total_s * 1e3, 3),
+        }
+        for f in sched.finished
+    ]
+    out = {
+        "serving": {
+            "layout": args.layout,
+            "shards": shards,
+            "collective_matmul": args.collective_matmul,
+            "num_slots": args.num_slots,
+            "max_len": args.max_len,
+            "prefill_len": args.prefill_len,
+            **report,
+        },
+        "requests": per_request,
+    }
+    if jax.process_index() == 0:
+        print(json.dumps(out, indent=2))
+    return out
+
+
+if __name__ == "__main__":
+    main()

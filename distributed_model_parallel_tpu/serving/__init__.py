@@ -1,0 +1,36 @@
+"""Inference-side subsystem: continuous batching over a slot-paged,
+preallocated KV cache (Yu et al., Orca, OSDI 2022; Kwon et al.,
+PagedAttention, SOSP 2023 — PAPERS.md).
+
+The training engines in `parallel/` own the forward+backward step; this
+package owns the autoregressive SERVING step: a prefill/decode split
+where one jitted token-step advances a mixed batch of sequences sitting
+at different positions, new requests are admitted into recycled cache
+slots every iteration, and the TP/SP layouts reuse the same mesh axes,
+parameter pytrees, and latency-hiding kernels the training side built
+(`ops/collective_matmul.py` rings at decode time,
+`ops/ring_attention.py` for sharded prefill). INTERNALS.md §9 has the
+anatomy.
+"""
+
+from distributed_model_parallel_tpu.serving.engine import ServingEngine
+from distributed_model_parallel_tpu.serving.kv_cache import (
+    KVCacheSpec,
+    SlotAllocator,
+    cache_pspecs,
+    init_cache,
+)
+from distributed_model_parallel_tpu.serving.scheduler import (
+    Request,
+    Scheduler,
+)
+
+__all__ = [
+    "KVCacheSpec",
+    "Request",
+    "Scheduler",
+    "ServingEngine",
+    "SlotAllocator",
+    "cache_pspecs",
+    "init_cache",
+]

@@ -1,0 +1,327 @@
+"""Incremental (KV-cached) decode and prefill, threaded through the
+existing `attention_fn(q, k, v, mask)` seam.
+
+The decoder blocks are NOT rewritten for inference: `gpt.decoder_blocks`
+builds the same `models/transformer.py` layers the training engines run,
+and the cache plumbing rides the `attention_fn` parameter — per traced
+step a fresh recorder object is constructed, the blocks are rebuilt
+around it (Layer construction is just closures; params come from the
+dense `gpt_lm` pytree, so checkpoints and the TP/SP training engines'
+states serve unchanged), and each block's single `attention_fn` call
+becomes one layer's cache update + incremental attention:
+
+  * decode (`CacheAttention`): the block hands over the NEW token's
+    q/k/v (B, 1, H, Dh); the recorder writes k/v into layer `i` of the
+    cache at each slot's own position (a ragged batch — every slot sits
+    at a different position), then attends q against the full cached
+    prefix through `ops.attention.dot_product_attention` with a
+    per-slot key-validity mask — the same core the dense model runs,
+    so logits are pinned identical to full recompute
+    (tests/test_serving.py).
+  * sp decode (`SeqShardedCacheAttention`): the cache's position axis
+    is sharded over 'seq'; each shard attends q over ITS positions and
+    the partial results merge with the online-softmax recurrence
+    (pmax of the running max, psum of the exp-sums and weighted
+    values) — the same flash-style merge `ops/ring_attention.py` uses,
+    exact, not approximate.
+  * prefill (`PrefillRecorder`): wraps any causal attention core
+    (dense `dot_product_attention` or, under the sp layout,
+    `ring_attention` over 'seq' — long prefill reuses the training
+    ring) and captures each layer's full-prompt K/V for the cache
+    write.
+
+Decode-time TP projections ride the latency-hiding rings
+(`DecodeCollectiveMatmul`): at decode the sequence axis is one token,
+so the chunked `ag_matmul`/`matmul_rs` rings run over the SLOT-BATCH
+axis instead — the residual stream between blocks is slot-sharded over
+'model' (the decode analog of the Megatron-SP layout), column
+projections gather slots via S-1 ppermute hops, row projections
+reduce-scatter partial sums back, and no monolithic all-gather touches
+the opted-in path (pinned by the hlolint `serve-decode-ring` rule:
+exactly 4·L·(S-1) permutes per decode step).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+from typing import Any, List, Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+from distributed_model_parallel_tpu.ops.attention import (
+    dot_product_attention,
+)
+from distributed_model_parallel_tpu.ops.collective_matmul import (
+    ag_matmul,
+    matmul_rs,
+)
+from distributed_model_parallel_tpu.runtime.compat import shard_map
+
+
+# --------------------------------------------------------------- stems
+
+
+def decode_stem(stem_params, tokens, positions, dtype):
+    """One-token stem: word embedding of each slot's incoming token plus
+    ITS OWN position row — the dense `gpt.stem_apply` broadcasts one
+    shared position slice over the batch, which cannot express a ragged
+    (mixed-position) decode batch, so the gather is per-slot here.
+    tokens/positions (slots,) -> h (slots, 1, dim)."""
+    h = jnp.take(stem_params["word"], tokens, axis=0)[:, None, :]
+    pos = jnp.take(stem_params["position"], positions, axis=0)[:, None, :]
+    h = h + pos
+    if dtype is not None:
+        h = h.astype(dtype)
+    return h
+
+
+def prefill_stem(stem_params, ids, offset, dtype):
+    """Prompt stem over (B, T) ids starting at global position `offset`
+    (0 for the dense layouts; the shard's global offset under 'seq'
+    sharding, mirroring the SP training engines)."""
+    t = ids.shape[1]
+    pos = lax.dynamic_slice_in_dim(
+        stem_params["position"], offset, t, axis=0
+    )
+    h = jnp.take(stem_params["word"], ids, axis=0) + pos[None]
+    if dtype is not None:
+        h = h.astype(dtype)
+    return h
+
+
+# ----------------------------------------------------- cache utilities
+
+
+def write_position(cache_layer, new, positions, active):
+    """Write each slot's (1, H, Dh) update at its own position along
+    the cache's position axis; inactive slots keep their old row
+    (admission gaps must not smear garbage into recycled slots).
+    cache_layer (slots, max_len, H, Dh), new (slots, 1, H, Dh)."""
+    upd = jax.vmap(
+        lambda c, u, p: lax.dynamic_update_slice_in_dim(
+            c, u.astype(c.dtype), p, axis=0
+        )
+    )(cache_layer, new, positions)
+    return jnp.where(active[:, None, None, None], upd, cache_layer)
+
+
+# ------------------------------------------------- decode attention fns
+
+
+class CacheAttention:
+    """attention_fn for one traced decode step, replicated/TP layouts.
+
+    Construct fresh per trace with the incoming cache; each block's
+    call consumes the next layer index in order (the blocks apply
+    sequentially, so call order IS layer order). After the blocks run,
+    `.k`/`.v` hold the updated stacked caches."""
+
+    def __init__(self, k, v, positions, active):
+        self.k = k  # (layers, slots, max_len, H, Dh)
+        self.v = v
+        self.positions = positions  # (slots,) write/attend position
+        self.active = active  # (slots,) bool
+        self.layer = 0
+
+    def __call__(self, q, k_new, v_new, mask):
+        i = self.layer
+        self.layer += 1
+        kc = write_position(self.k[i], k_new, self.positions, self.active)
+        vc = write_position(self.v[i], v_new, self.positions, self.active)
+        self.k = self.k.at[i].set(kc)
+        self.v = self.v.at[i].set(vc)
+        # Keys at the slot's position or earlier are the live prefix
+        # (the new token was just written AT the position); later
+        # positions are zero padding or a recycled slot's stale tail.
+        valid = (
+            jnp.arange(kc.shape[1])[None, :] <= self.positions[:, None]
+        )
+        return dot_product_attention(q, kc, vc, mask=valid)
+
+
+class SeqShardedCacheAttention:
+    """attention_fn for one traced decode step under the sp layout —
+    call INSIDE shard_map over `axis`, with the cache's position axis
+    sharded: local cache (layers, slots, max_len/S, H, Dh).
+
+    Each shard writes the new K/V only if it owns the slot's position,
+    attends q over its own positions, and the partial softmaxes merge
+    exactly via the online recurrence (pmax/psum over `axis`)."""
+
+    def __init__(self, k, v, positions, active, *, axis: str = "seq"):
+        self.k = k
+        self.v = v
+        self.positions = positions
+        self.active = active
+        self.axis = axis
+        self.layer = 0
+
+    def _write(self, cache_layer, new):
+        chunk = cache_layer.shape[1]
+        idx = lax.axis_index(self.axis)
+        local_p = self.positions - idx * chunk
+        owns = (local_p >= 0) & (local_p < chunk) & self.active
+        upd = jax.vmap(
+            lambda c, u, p: lax.dynamic_update_slice_in_dim(
+                c, u.astype(c.dtype), p, axis=0
+            )
+        )(cache_layer, new, jnp.clip(local_p, 0, chunk - 1))
+        return jnp.where(owns[:, None, None, None], upd, cache_layer)
+
+    def __call__(self, q, k_new, v_new, mask):
+        i = self.layer
+        self.layer += 1
+        kc = self._write(self.k[i], k_new)
+        vc = self._write(self.v[i], v_new)
+        self.k = self.k.at[i].set(kc)
+        self.v = self.v.at[i].set(vc)
+        chunk = kc.shape[1]
+        idx = lax.axis_index(self.axis)
+        # Global validity of THIS shard's positions: every global
+        # position <= the slot's position lives on exactly one shard,
+        # so the union over shards is the dense prefix mask.
+        gpos = idx * chunk + jnp.arange(chunk)
+        valid = gpos[None, :] <= self.positions[:, None]  # (slots, C)
+        dh = q.shape[-1]
+        scale = 1.0 / jnp.sqrt(dh).astype(jnp.float32)
+        qf = q.astype(jnp.float32)
+        logits = jnp.einsum(
+            "bqhd,bkhd->bhqk", qf, kc.astype(jnp.float32)
+        ) * scale  # (slots, H, 1, C)
+        neg = jnp.finfo(jnp.float32).min
+        logits = jnp.where(valid[:, None, None, :], logits, neg)
+        # Online-softmax merge across shards (exact): shared running
+        # max, then one psum each for the exp-sums and weighted values.
+        m = lax.pmax(jnp.max(logits, axis=-1), self.axis)  # (slots,H,1)
+        p = jnp.exp(logits - m[..., None])
+        p = jnp.where(valid[:, None, None, :], p, 0.0)
+        denom = lax.psum(jnp.sum(p, axis=-1), self.axis)  # (slots,H,1)
+        num = lax.psum(
+            jnp.einsum("bhqk,bkhd->bqhd", p, vc.astype(jnp.float32)),
+            self.axis,
+        )  # (slots, 1, H, Dh)
+        out = num / jnp.swapaxes(denom, 1, 2)[..., None]
+        return out.astype(q.dtype)
+
+
+class PrefillRecorder:
+    """attention_fn wrapper for the prefill pass: runs `core` (causal
+    dense attention, or `ring_attention` under the sp layout) unchanged
+    and captures each layer's K/V for the cache write."""
+
+    def __init__(self, core):
+        self.core = core
+        self.ks: List[jax.Array] = []
+        self.vs: List[jax.Array] = []
+
+    def __call__(self, q, k, v, mask):
+        self.ks.append(k)
+        self.vs.append(v)
+        return self.core(q, k, v, mask)
+
+
+# ---------------------------------------- decode-time collective matmul
+
+
+@dataclasses.dataclass(frozen=True)
+class DecodeCollectiveMatmul:
+    """Latency-hiding policy for TP DECODE steps (`Context.matmul` ->
+    `layers.project`, the same hook the training engines thread).
+
+    At decode the token axis is 1, so the training policy's
+    sequence-chunked rings have nothing to ring over; the slot-batch
+    axis is the long one instead. Column projections (qkv / ffn-in)
+    enter slot-sharded and gather the batch through the `ag_matmul`
+    ring (S-1 ppermutes, each hop overlapping the chunk dot); row
+    projections (attn-out / ffn-out) reduce-scatter partial sums back
+    onto the slot shards via `matmul_rs`. Between the pairs,
+    activations sit exactly where the declarative TP layout puts them
+    (head/feature-sharded), so the cache attention is untouched; the
+    residual stream between blocks rides slot-sharded over `axis` —
+    the decode analog of the Megatron-SP layout."""
+
+    mesh: Mesh
+    axis: str = "model"
+    attn: bool = True
+    ffn: bool = True
+
+    def _check(self, rows: int, features: int, fdim: str) -> None:
+        size = self.mesh.shape[self.axis]
+        if rows % size:
+            raise ValueError(
+                f"decode collective_matmul rings over the slot batch: "
+                f"{rows} slots not divisible by the {size}-way "
+                f"'{self.axis}' axis"
+            )
+        if features % size:
+            raise ValueError(
+                f"decode collective_matmul: {fdim} ({features}) not "
+                f"divisible by the {size}-way '{self.axis}' axis"
+            )
+
+    def column(self, h, w, b):
+        """(slots, 1, D) -> (slots, 1, F) F-sharded; slots gathered via
+        the ag_matmul ring."""
+        slots = h.shape[0]
+        self._check(slots, w.shape[-1], "output features")
+        fn = shard_map(
+            partial(_decode_column, axis_name=self.axis),
+            mesh=self.mesh,
+            in_specs=(P(self.axis, None), P(None, self.axis),
+                      P(self.axis)),
+            out_specs=P(None, self.axis),
+            check_vma=False,
+        )
+        # The named scope is the hlolint anchor: `serve-decode-ring`
+        # counts exactly these permutes (GSPMD's own resharding
+        # permutes around the regions stay untagged).
+        with jax.named_scope("serve_ring"):
+            y = fn(h[:, 0, :], w, b)
+        return y[:, None, :]
+
+    def row(self, h, w, b):
+        """(slots, 1, F) F-sharded -> (slots, 1, D); partial sums
+        reduce-scattered onto the slot shards via the matmul_rs ring."""
+        slots = h.shape[0]
+        self._check(slots, w.shape[0], "input features")
+        fn = shard_map(
+            partial(_decode_row, axis_name=self.axis),
+            mesh=self.mesh,
+            in_specs=(P(None, self.axis), P(self.axis, None), P()),
+            out_specs=P(self.axis, None),
+            check_vma=False,
+        )
+        with jax.named_scope("serve_ring"):
+            y = fn(h[:, 0, :], w, b)
+        return y[:, None, :]
+
+
+def _decode_column(hl, wl, bl, *, axis_name):
+    return ag_matmul(hl, wl, axis_name) + bl
+
+
+def _decode_row(hl, wl, b, *, axis_name):
+    return matmul_rs(hl, wl, axis_name) + b
+
+
+def decode_ring_permutes(num_layers: int, size: int) -> int:
+    """The exact collective-permute count of one opted-in decode step:
+    4 projection rings per block (qkv, attn-out, ffn-in, ffn-out),
+    S-1 hops each, no backward — the hlolint `serve-decode-ring` pin."""
+    return 4 * num_layers * (size - 1)
+
+
+__all__ = [
+    "CacheAttention",
+    "DecodeCollectiveMatmul",
+    "PrefillRecorder",
+    "SeqShardedCacheAttention",
+    "decode_ring_permutes",
+    "decode_stem",
+    "prefill_stem",
+    "write_position",
+]

@@ -1,0 +1,497 @@
+"""ServingEngine: prefill/decode split with continuous batching over
+the slot-paged KV cache.
+
+One engine = one compiled prefill program + ONE compiled decode program
+that advances EVERY cache slot a single token per call, whatever
+position each slot sits at (the mixed-position batch is the point of
+continuous batching — Orca, PAPERS.md). The host loop
+(`ServingEngine.run`) does iteration-level scheduling: admit waiting
+requests into free slots (prefill), one decode step for the active
+set, evict finished sequences and recycle their slots.
+
+Parameters are the dense `models/gpt.gpt_lm` pytree — the SAME tree the
+TP and SP-LM training engines train (`TrainState.params` serves
+directly), placed per layout:
+
+  replicated — params + cache replicated; plain jit.
+  tp         — params sharded by `MEGATRON_RULES` on the 'model' axis
+               (the TensorParallelEngine layout), cache head-sharded;
+               GSPMD inserts the decode collectives — or, with
+               `collective_matmul=True`, the opted-in projections ride
+               chunked ppermute rings over the slot batch
+               (`serving/decode.DecodeCollectiveMatmul`): exactly
+               4·L·(S-1) permutes per decode step and no monolithic
+               all-gather on the opted-in path (hlolint
+               `serve-decode-ring`).
+  sp         — cache position-sharded over 'seq'; decode merges
+               per-shard partial attention via the online-softmax
+               recurrence, and long prefill reuses the training ring
+               (`ops/ring_attention.py`) over the same axis.
+
+All three are logit-identical to full-sequence recompute at rtol 1e-5
+(tests/test_serving.py) — the cache is an optimization, never an
+approximation.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from functools import partial
+from typing import Any, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from distributed_model_parallel_tpu.models import layers as L
+from distributed_model_parallel_tpu.models.gpt import (
+    GPTConfig,
+    decoder_blocks,
+    gpt_lm,
+    head_apply,
+)
+from distributed_model_parallel_tpu.ops.attention import (
+    dot_product_attention,
+)
+from distributed_model_parallel_tpu.ops.ring_attention import (
+    ring_attention,
+)
+from distributed_model_parallel_tpu.runtime.compat import shard_map
+from distributed_model_parallel_tpu.serving.decode import (
+    CacheAttention,
+    DecodeCollectiveMatmul,
+    PrefillRecorder,
+    SeqShardedCacheAttention,
+    decode_stem,
+    prefill_stem,
+)
+from distributed_model_parallel_tpu.serving.kv_cache import (
+    KVCacheSpec,
+    cache_pspecs,
+    cache_shardings,
+    init_cache,
+)
+from distributed_model_parallel_tpu.serving.scheduler import (
+    Request,
+    Scheduler,
+)
+
+
+@dataclasses.dataclass
+class ServingEngine:
+    """Autoregressive serving over `models/gpt` configs (module doc)."""
+
+    cfg: GPTConfig
+    mesh: Optional[Mesh] = None
+    layout: str = "replicated"  # replicated | tp | sp
+    num_slots: int = 4
+    max_len: Optional[int] = None  # cache positions; <= cfg.max_position
+    prefill_len: Optional[int] = None  # padded prompt length; <= max_len
+    # Latency-hiding decode rings over 'model' (tp layout only):
+    # `serving/decode.DecodeCollectiveMatmul`. Default off, same math.
+    collective_matmul: bool = False
+    compute_dtype: Any = None  # activation dtype; None = f32
+    donate: bool = True  # donate the cache buffers step-over-step
+
+    def __post_init__(self):
+        cfg = self.cfg
+        self.max_len = self.max_len or cfg.max_position
+        self.prefill_len = self.prefill_len or self.max_len
+        if self.max_len > cfg.max_position:
+            raise ValueError(
+                f"max_len {self.max_len} exceeds the position table "
+                f"(cfg.max_position={cfg.max_position})"
+            )
+        if not 1 <= self.prefill_len <= self.max_len:
+            raise ValueError(
+                f"prefill_len {self.prefill_len} must be in "
+                f"[1, max_len={self.max_len}]"
+            )
+        if cfg.dim % cfg.num_heads:
+            raise ValueError(
+                f"dim {cfg.dim} not divisible by heads {cfg.num_heads}"
+            )
+        cache_dtype = self.compute_dtype or jnp.float32
+        self.spec = KVCacheSpec(
+            num_layers=cfg.num_layers, num_slots=self.num_slots,
+            max_len=self.max_len, num_heads=cfg.num_heads,
+            head_dim=cfg.dim // cfg.num_heads, dtype=cache_dtype,
+        )
+        self.spec.validate(self.layout, self.mesh)
+        if self.collective_matmul and self.layout != "tp":
+            raise ValueError(
+                "collective_matmul=True rings decode projections over "
+                "the 'model' axis; it requires layout='tp' "
+                f"(got {self.layout!r})"
+            )
+        self._mm = None
+        if self.layout == "tp":
+            s = self.mesh.shape["model"]
+            if self.num_slots % s:
+                # The decode step keeps logits slot-sharded over
+                # 'model' (no final gather inside the program), and the
+                # opted-in rings chunk the slot batch — both need the
+                # slot axis divisible. Fail here, not at trace time.
+                raise ValueError(
+                    f"tp layout shards the slot batch over 'model': "
+                    f"num_slots {self.num_slots} not divisible by {s} "
+                    "shards"
+                )
+            if self.collective_matmul:
+                if s < 2:
+                    raise ValueError(
+                        "collective_matmul=True needs a 'model' axis "
+                        ">= 2 to ring over (a 1-shard ring is a plain "
+                        "dot)"
+                    )
+                for n, label in (
+                    (self.num_slots, "num_slots"),
+                    (3 * cfg.dim, "qkv width (3*dim)"),
+                    (cfg.dim, "dim"),
+                    (cfg.ffn_dim, "ffn_dim"),
+                ):
+                    if n % s:
+                        raise ValueError(
+                            f"decode collective_matmul: {label} ({n}) "
+                            f"must be divisible by the {s}-way 'model' "
+                            "axis"
+                        )
+                self._mm = DecodeCollectiveMatmul(
+                    mesh=self.mesh, axis="model"
+                )
+        if self.layout == "sp":
+            s = self.mesh.shape["seq"]
+            if self.prefill_len % s:
+                raise ValueError(
+                    f"sp prefill shards the prompt over 'seq': "
+                    f"prefill_len {self.prefill_len} not divisible by "
+                    f"{s} shards"
+                )
+        # Dense-parameter twin: init + checkpoint interop with the
+        # training engines (identical pytree).
+        self._full = gpt_lm(cfg)
+        self._blocks_state = {
+            str(i): {} for i in range(cfg.num_layers)
+        }
+        self._build_shardings()
+        self._build_steps()
+
+    # ------------------------------------------------------- shardings
+
+    def _build_shardings(self):
+        mesh = self.mesh
+        if mesh is None:
+            self._param_sh = self._cache_sh = self._repl = None
+            return
+        self._repl = NamedSharding(mesh, P())
+        if self.layout == "tp":
+            from distributed_model_parallel_tpu.parallel.tensor_parallel import (  # noqa: E501
+                MEGATRON_RULES,
+                shard_specs,
+            )
+
+            key_aval = jax.ShapeDtypeStruct((2,), jnp.uint32)
+            p_aval, _ = jax.eval_shape(self._full.init, key_aval)
+            self._param_sh = jax.tree_util.tree_map(
+                lambda spec: NamedSharding(mesh, spec),
+                shard_specs(p_aval, MEGATRON_RULES),
+                is_leaf=lambda x: isinstance(x, P),
+            )
+        else:
+            self._param_sh = self._repl
+        self._cache_sh = cache_shardings(mesh, self.layout)
+
+    # ----------------------------------------------------------- steps
+
+    def _build_steps(self):
+        cfg = self.cfg
+        cdt = self.compute_dtype
+        num_slots = self.num_slots
+        max_len = self.max_len
+        p_len = self.prefill_len
+        blocks_state = self._blocks_state
+        mm = self._mm
+        ctx = L.Context(train=False, dtype=cdt)
+
+        def run_blocks(params, x, attention_fn, block_ctx):
+            blocks = L.sequential(*decoder_blocks(cfg, attention_fn))
+            (h, _), _ = blocks.apply(
+                params["blocks"], blocks_state, x, block_ctx
+            )
+            return h
+
+        # --- decode: one token for every slot, mixed positions -------
+        def decode_step(params, cache, tokens, active):
+            positions = cache["lengths"]
+            rec = CacheAttention(
+                cache["k"], cache["v"], positions, active
+            )
+            h = decode_stem(
+                params["stem"], tokens,
+                jnp.clip(positions, 0, cfg.max_position - 1), cdt,
+            )
+            mask = jnp.ones((num_slots, 1), jnp.bool_)
+            h = run_blocks(
+                params, (h, mask), rec,
+                dataclasses.replace(ctx, matmul=mm),
+            )
+            logits = head_apply(params["head"], h)[:, 0, :]
+            new_lengths = jnp.where(active, positions + 1, positions)
+            new_cache = {
+                "k": rec.k, "v": rec.v, "lengths": new_lengths,
+            }
+            return new_cache, logits
+
+        def sp_decode_step(params, cache, tokens, active):
+            positions = cache["lengths"]
+            rec = SeqShardedCacheAttention(
+                cache["k"], cache["v"], positions, active, axis="seq"
+            )
+            h = decode_stem(
+                params["stem"], tokens,
+                jnp.clip(positions, 0, cfg.max_position - 1), cdt,
+            )
+            mask = jnp.ones((num_slots, 1), jnp.bool_)
+            h = run_blocks(params, (h, mask), rec, ctx)
+            logits = head_apply(params["head"], h)[:, 0, :]
+            new_lengths = jnp.where(active, positions + 1, positions)
+            new_cache = {
+                "k": rec.k, "v": rec.v, "lengths": new_lengths,
+            }
+            return new_cache, logits
+
+        # --- prefill: one padded prompt into one slot ----------------
+        def prefill_step(params, cache, ids, length, slot):
+            mask = jnp.arange(p_len)[None, :] < length  # (1, P)
+            h = prefill_stem(params["stem"], ids, 0, cdt)
+            rec = PrefillRecorder(
+                partial(dot_product_attention, causal=True)
+            )
+            h = run_blocks(params, (h, mask), rec, ctx)
+            logits = head_apply(params["head"], h)  # (1, P, V) f32
+            next_logits = lax.dynamic_index_in_dim(
+                logits[0], length - 1, axis=0, keepdims=False
+            )
+            k_stack = jnp.stack([k[0] for k in rec.ks])  # (L,P,H,Dh)
+            v_stack = jnp.stack([v[0] for v in rec.vs])
+            pad = ((0, 0), (0, max_len - p_len), (0, 0), (0, 0))
+            new_cache = {
+                "k": lax.dynamic_update_slice(
+                    cache["k"],
+                    jnp.pad(k_stack, pad)[:, None].astype(
+                        cache["k"].dtype
+                    ),
+                    (0, slot, 0, 0, 0),
+                ),
+                "v": lax.dynamic_update_slice(
+                    cache["v"],
+                    jnp.pad(v_stack, pad)[:, None].astype(
+                        cache["v"].dtype
+                    ),
+                    (0, slot, 0, 0, 0),
+                ),
+                "lengths": cache["lengths"].at[slot].set(length),
+            }
+            return new_cache, next_logits
+
+        def sp_prefill_step(params, cache, ids, length, slot):
+            s = self.mesh.shape["seq"]
+            tl = p_len // s
+            chunk = max_len // s
+            idx = lax.axis_index("seq")
+            offset = idx * tl
+            gmask = (offset + jnp.arange(tl))[None, :] < length
+            h = prefill_stem(params["stem"], ids, offset, cdt)
+            rec = PrefillRecorder(
+                partial(ring_attention, axis_name="seq", causal=True)
+            )
+            h = run_blocks(params, (h, gmask), rec, ctx)
+            logits = head_apply(params["head"], h)  # (1, tl, V)
+            # The next-token logits live on the shard owning global
+            # position length-1; psum broadcasts that one row.
+            owner = (length - 1) // tl
+            li = jnp.clip(length - 1 - offset, 0, tl - 1)
+            row = jnp.where(
+                idx == owner,
+                lax.dynamic_index_in_dim(
+                    logits[0], li, axis=0, keepdims=False
+                ),
+                jnp.zeros((cfg.vocab_size,), jnp.float32),
+            )
+            next_logits = lax.psum(row, "seq")
+            # Each cache shard owns positions [idx*chunk, (idx+1)*chunk);
+            # gather the prompt K/V once, pad to max_len, keep my chunk.
+            k_stack = jnp.stack([k[0] for k in rec.ks])  # (L,tl,H,Dh)
+            v_stack = jnp.stack([v[0] for v in rec.vs])
+            pad = ((0, 0), (0, max_len - p_len), (0, 0), (0, 0))
+
+            def my_chunk(stack):
+                full = jnp.pad(
+                    lax.all_gather(stack, "seq", axis=1, tiled=True),
+                    pad,
+                )
+                return lax.dynamic_slice_in_dim(
+                    full, idx * chunk, chunk, axis=1
+                )
+
+            new_cache = {
+                "k": lax.dynamic_update_slice(
+                    cache["k"],
+                    my_chunk(k_stack)[:, None].astype(cache["k"].dtype),
+                    (0, slot, 0, 0, 0),
+                ),
+                "v": lax.dynamic_update_slice(
+                    cache["v"],
+                    my_chunk(v_stack)[:, None].astype(cache["v"].dtype),
+                    (0, slot, 0, 0, 0),
+                ),
+                "lengths": cache["lengths"].at[slot].set(length),
+            }
+            return new_cache, next_logits
+
+        donate = (1,) if self.donate else ()  # the cache argument
+        if self.layout == "sp":
+            mesh = self.mesh
+            cspec = cache_pspecs("sp")
+            self.decode_step = jax.jit(
+                shard_map(
+                    sp_decode_step, mesh=mesh,
+                    in_specs=(P(), cspec, P(), P()),
+                    out_specs=(cspec, P()),
+                    check_vma=False,
+                ),
+                donate_argnums=donate,
+            )
+            self.prefill = jax.jit(
+                shard_map(
+                    sp_prefill_step, mesh=mesh,
+                    in_specs=(P(), cspec, P(None, "seq"), P(), P()),
+                    out_specs=(cspec, P()),
+                    check_vma=False,
+                ),
+                donate_argnums=donate,
+            )
+        elif self.mesh is not None:
+            # replicated-with-mesh and tp: declarative placement; the
+            # opted-in tp rings enter via ctx.matmul inside decode_step.
+            logits_sh = (
+                NamedSharding(self.mesh, P("model", None))
+                if self.layout == "tp" else self._repl
+            )
+            self.decode_step = jax.jit(
+                decode_step,
+                in_shardings=(
+                    self._param_sh, self._cache_sh, self._repl,
+                    self._repl,
+                ),
+                out_shardings=(self._cache_sh, logits_sh),
+                donate_argnums=donate,
+            )
+            self.prefill = jax.jit(
+                prefill_step,
+                in_shardings=(
+                    self._param_sh, self._cache_sh, self._repl,
+                    self._repl, self._repl,
+                ),
+                out_shardings=(self._cache_sh, self._repl),
+                donate_argnums=donate,
+            )
+        else:
+            self.decode_step = jax.jit(
+                decode_step, donate_argnums=donate
+            )
+            self.prefill = jax.jit(
+                prefill_step, donate_argnums=donate
+            )
+
+    # ------------------------------------------------------------ state
+
+    def init_params(self, rng: jax.Array):
+        """Fresh dense-twin parameters (`gpt_lm(cfg)` pytree — a trained
+        TrainState.params from the TP / SP-LM engines drops in via
+        `place_params`)."""
+        params, _ = self._full.init(rng)
+        return self.place_params(params)
+
+    def place_params(self, params):
+        """Place an existing dense-layout param pytree (a checkpoint or
+        a training engine's canonical params) into this layout."""
+        if self._param_sh is None:
+            return params
+        return jax.device_put(params, self._param_sh)
+
+    def init_cache(self) -> dict:
+        cache = init_cache(self.spec)
+        if self._cache_sh is None:
+            return cache
+        return jax.device_put(cache, self._cache_sh)
+
+    # ---------------------------------------------------------- serving
+
+    def pad_prompt(self, prompt: np.ndarray):
+        """(ids (1, prefill_len) int32, length int32) for one prompt."""
+        prompt = np.asarray(prompt, np.int32).reshape(-1)
+        if not 1 <= prompt.size <= self.prefill_len:
+            raise ValueError(
+                f"prompt length {prompt.size} must be in "
+                f"[1, prefill_len={self.prefill_len}]"
+            )
+        ids = np.zeros((1, self.prefill_len), np.int32)
+        ids[0, : prompt.size] = prompt
+        return jnp.asarray(ids), jnp.int32(prompt.size)
+
+    def run(self, params, requests: Sequence[Request]) -> Scheduler:
+        """Offline continuous batching: drive the request set to
+        completion (greedy decoding), returning the Scheduler with its
+        per-request `finished` records and `latency_report()`."""
+        sched = Scheduler(self.num_slots, self.max_len)
+        for r in requests:
+            if r.prompt.size > self.prefill_len:
+                raise ValueError(
+                    f"request {r.rid!r}: prompt length {r.prompt.size} "
+                    f"exceeds prefill_len {self.prefill_len}"
+                )
+            sched.submit(r)
+        cache = self.init_cache()
+        tokens = np.zeros((self.num_slots,), np.int32)
+        active = np.zeros((self.num_slots,), bool)
+        while sched.has_work():
+            # Admission: prefill waiting requests into free slots.
+            while sched.can_admit():
+                seq = sched.admit()
+                ids, length = self.pad_prompt(seq.request.prompt)
+                cache, next_logits = self.prefill(
+                    params, cache, ids, length, jnp.int32(seq.slot)
+                )
+                tok = int(np.asarray(next_logits).argmax())
+                seq.t_first_token = time.perf_counter()
+                seq.generated.append(tok)
+                tokens[seq.slot] = tok
+                active[seq.slot] = True
+                if seq.done(self.max_len):
+                    sched.finish(seq.slot)
+                    active[seq.slot] = False
+            if not active.any():
+                continue
+            # One decode step for the whole mixed-position batch.
+            t0 = time.perf_counter()
+            cache, logits = self.decode_step(
+                params, cache, jnp.asarray(tokens), jnp.asarray(active)
+            )
+            logits_np = np.asarray(logits)
+            dt = time.perf_counter() - t0
+            for slot, seq in list(sched.active.items()):
+                tok = int(logits_np[slot].argmax())
+                seq.generated.append(tok)
+                seq.token_times.append(dt)
+                tokens[slot] = tok
+                if seq.done(self.max_len):
+                    sched.finish(slot)
+                    active[slot] = False
+        return sched
+
+
+__all__ = ["ServingEngine"]

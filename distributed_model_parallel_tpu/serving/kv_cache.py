@@ -1,0 +1,165 @@
+"""Slot-paged, preallocated KV cache for autoregressive serving.
+
+The cache is one fixed-size pytree allocated ONCE per engine — no
+per-request allocation, no shape churn, no recompiles:
+
+    {"k": (layers, slots, max_len, heads, head_dim),
+     "v": (layers, slots, max_len, heads, head_dim),
+     "lengths": (slots,) int32}
+
+A SLOT is the unit of admission (Orca's iteration-level scheduling,
+PAPERS.md): each active request owns one slot for its lifetime, its
+per-slot `lengths` counter marks how many positions hold real K/V, and
+eviction is a host-side free-list operation (`SlotAllocator`) — the
+device buffers are never resized or compacted, a recycled slot is
+simply overwritten from position 0 (stale tail positions stay masked
+until each decode step overwrites its own position before attending).
+This is PagedAttention's insight at page-size = max_len: preallocate,
+never fragment the compiled shapes.
+
+Within a slot, axes follow the repo's (B, T, H, Dh) attention
+convention (`ops/attention.py`) so the cache feeds
+`dot_product_attention` / the SP online-softmax without transposes.
+
+Three mesh layouts, chosen to match the TRAINING engine whose params
+are being served (`cache_pspecs`):
+
+  replicated — every device holds the full cache (single-chip or pure
+               data-parallel serving).
+  tp         — heads sharded over 'model', the Megatron axis: the
+               head-sharded q/k/v a column-parallel qkv projection
+               produces attend against their local head shard
+               (`parallel/tensor_parallel.py` layouts).
+  sp         — max_len sharded over 'seq': each shard owns a
+               contiguous range of global positions, decode combines
+               per-shard partial attention with the same online-softmax
+               recurrence `ops/ring_attention.py` uses.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, List, Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+LAYOUTS = ("replicated", "tp", "sp")
+
+
+@dataclasses.dataclass(frozen=True)
+class KVCacheSpec:
+    """Static shape of the preallocated cache (one per ServingEngine)."""
+
+    num_layers: int
+    num_slots: int
+    max_len: int
+    num_heads: int
+    head_dim: int
+    dtype: Any = jnp.float32
+
+    def validate(self, layout: str, mesh: Optional[Mesh]) -> None:
+        """Fail at construction (not at trace time) when the cache
+        cannot be laid out on the mesh."""
+        if layout not in LAYOUTS:
+            raise ValueError(
+                f"layout must be one of {LAYOUTS}, got {layout!r}"
+            )
+        if layout == "replicated":
+            return
+        if mesh is None:
+            raise ValueError(f"layout {layout!r} needs a mesh")
+        if layout == "tp":
+            s = mesh.shape["model"]
+            if self.num_heads % s:
+                raise ValueError(
+                    f"tp cache shards heads over 'model': num_heads "
+                    f"{self.num_heads} not divisible by {s} shards"
+                )
+        if layout == "sp":
+            s = mesh.shape["seq"]
+            if self.max_len % s:
+                raise ValueError(
+                    f"sp cache shards positions over 'seq': max_len "
+                    f"{self.max_len} not divisible by {s} shards"
+                )
+
+
+def cache_pspecs(layout: str) -> dict:
+    """PartitionSpec pytree for one cache (see module docstring)."""
+    if layout == "tp":
+        kv = P(None, None, None, "model", None)
+    elif layout == "sp":
+        kv = P(None, None, "seq", None, None)
+    else:
+        kv = P()
+    return {"k": kv, "v": kv, "lengths": P()}
+
+
+def cache_shardings(mesh: Mesh, layout: str) -> dict:
+    return jax.tree_util.tree_map(
+        lambda spec: NamedSharding(mesh, spec),
+        cache_pspecs(layout),
+        is_leaf=lambda x: isinstance(x, P),
+    )
+
+
+def init_cache(spec: KVCacheSpec) -> dict:
+    """Zero-filled cache pytree; place with `cache_shardings`."""
+    kv_shape = (
+        spec.num_layers, spec.num_slots, spec.max_len,
+        spec.num_heads, spec.head_dim,
+    )
+    return {
+        "k": jnp.zeros(kv_shape, spec.dtype),
+        "v": jnp.zeros(kv_shape, spec.dtype),
+        "lengths": jnp.zeros((spec.num_slots,), jnp.int32),
+    }
+
+
+class SlotAllocator:
+    """Host-side free-list over the cache's slot axis.
+
+    Admission takes the lowest free slot (deterministic traces),
+    eviction returns it; the device-side buffers are untouched — a
+    recycled slot's stale K/V beyond the new request's positions stays
+    masked by the per-slot length until overwritten."""
+
+    def __init__(self, num_slots: int):
+        if num_slots < 1:
+            raise ValueError(f"num_slots must be >= 1, got {num_slots}")
+        self.num_slots = num_slots
+        self._free: List[int] = list(range(num_slots))
+        self._live: set = set()
+
+    @property
+    def free_slots(self) -> int:
+        return len(self._free)
+
+    def alloc(self) -> int:
+        if not self._free:
+            raise RuntimeError(
+                f"all {self.num_slots} cache slots are live; evict "
+                "(finish) a sequence before admitting another"
+            )
+        slot = min(self._free)
+        self._free.remove(slot)
+        self._live.add(slot)
+        return slot
+
+    def free(self, slot: int) -> None:
+        if slot not in self._live:
+            raise ValueError(f"slot {slot} is not live")
+        self._live.remove(slot)
+        self._free.append(slot)
+
+
+__all__ = [
+    "KVCacheSpec",
+    "LAYOUTS",
+    "SlotAllocator",
+    "cache_pspecs",
+    "cache_shardings",
+    "init_cache",
+]

@@ -1,0 +1,182 @@
+"""Continuous-batching request scheduler (Orca, OSDI 2022 — PAPERS.md).
+
+Iteration-level scheduling: the unit of work is one engine STEP, not
+one request. Every step the engine (a) admits waiting requests into
+free cache slots (prefill), (b) runs ONE jitted decode step for the
+whole mixed-position batch, and (c) evicts finished sequences, whose
+slots recycle immediately — a long request never holds the batch
+hostage, and a short one never waits for the batch to drain.
+
+The scheduler is deliberately host-side and tiny: FIFO admission over
+a `SlotAllocator` free list, per-sequence bookkeeping (generated
+tokens, timing legs for the latency report). Policy experiments
+(priority, preemption) swap this class without touching the engine.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from collections import deque
+from typing import Any, Deque, Dict, List, Optional
+
+import numpy as np
+
+from distributed_model_parallel_tpu.serving.kv_cache import SlotAllocator
+
+
+@dataclasses.dataclass
+class Request:
+    """One generation request. `prompt` is a 1-D int32 token vector;
+    generation stops after `max_new_tokens` or at `eos_id`."""
+
+    rid: Any
+    prompt: np.ndarray
+    max_new_tokens: int = 16
+    eos_id: Optional[int] = None
+
+    def __post_init__(self):
+        self.prompt = np.asarray(self.prompt, np.int32).reshape(-1)
+        if self.prompt.size < 1:
+            raise ValueError(f"request {self.rid!r}: empty prompt")
+        if self.max_new_tokens < 1:
+            raise ValueError(
+                f"request {self.rid!r}: max_new_tokens must be >= 1"
+            )
+
+
+@dataclasses.dataclass
+class Sequence:
+    """A live (admitted) request: its slot, generated tokens, and the
+    timing legs the latency report is built from."""
+
+    request: Request
+    slot: int
+    t_submit: float
+    t_admit: float = 0.0
+    t_first_token: float = 0.0
+    token_times: List[float] = dataclasses.field(default_factory=list)
+    generated: List[int] = dataclasses.field(default_factory=list)
+
+    @property
+    def position(self) -> int:
+        """Next write position: prompt + tokens generated so far."""
+        return int(self.request.prompt.size) + len(self.generated)
+
+    def done(self, max_len: int) -> bool:
+        r = self.request
+        if len(self.generated) >= r.max_new_tokens:
+            return True
+        if r.eos_id is not None and self.generated \
+                and self.generated[-1] == r.eos_id:
+            return True
+        # Out of cache positions: the slot cannot hold another token.
+        return self.position >= max_len
+
+
+@dataclasses.dataclass
+class FinishedSequence:
+    rid: Any
+    prompt_len: int
+    tokens: List[int]
+    prefill_s: float  # submit -> first token (queueing + prefill)
+    decode_s: List[float]  # per-token decode latencies
+    total_s: float
+
+
+class Scheduler:
+    """FIFO continuous batching over `num_slots` cache slots."""
+
+    def __init__(self, num_slots: int, max_len: int):
+        self.slots = SlotAllocator(num_slots)
+        self.max_len = max_len
+        # (t_submit, request) pairs: the submit time travels WITH the
+        # queue entry, so caller-supplied rids need not be unique.
+        self.waiting: Deque[tuple] = deque()
+        self.active: Dict[int, Sequence] = {}
+        self.finished: List[FinishedSequence] = []
+
+    # ------------------------------------------------------- lifecycle
+
+    def submit(self, request: Request) -> None:
+        if request.prompt.size >= self.max_len:
+            raise ValueError(
+                f"request {request.rid!r}: prompt length "
+                f"{request.prompt.size} leaves no room to generate "
+                f"(cache max_len {self.max_len})"
+            )
+        self.waiting.append((time.perf_counter(), request))
+
+    def can_admit(self) -> bool:
+        return bool(self.waiting) and self.slots.free_slots > 0
+
+    def admit(self) -> Sequence:
+        """Pop the next waiting request into the lowest free slot."""
+        t_submit, request = self.waiting.popleft()
+        slot = self.slots.alloc()
+        seq = Sequence(
+            request=request, slot=slot,
+            t_submit=t_submit,
+            t_admit=time.perf_counter(),
+        )
+        self.active[slot] = seq
+        return seq
+
+    def finish(self, slot: int) -> FinishedSequence:
+        """Evict a finished sequence and recycle its slot."""
+        seq = self.active.pop(slot)
+        self.slots.free(slot)
+        now = time.perf_counter()
+        fin = FinishedSequence(
+            rid=seq.request.rid,
+            prompt_len=int(seq.request.prompt.size),
+            tokens=list(seq.generated),
+            prefill_s=seq.t_first_token - seq.t_submit,
+            decode_s=list(seq.token_times),
+            total_s=now - seq.t_submit,
+        )
+        self.finished.append(fin)
+        return fin
+
+    def has_work(self) -> bool:
+        return bool(self.waiting) or bool(self.active)
+
+    # --------------------------------------------------------- reports
+
+    def latency_report(self) -> dict:
+        """Aggregate tokens/sec and per-token p50/p99 over the finished
+        set, split by leg (prefill = submit->first token, decode =
+        per-token step latency)."""
+        fins = self.finished
+        decode = np.asarray(
+            [t for f in fins for t in f.decode_s], np.float64
+        )
+        prefill = np.asarray([f.prefill_s for f in fins], np.float64)
+        n_tokens = int(sum(len(f.tokens) for f in fins))
+        total = max((f.total_s for f in fins), default=0.0)
+        out = {
+            "requests": len(fins),
+            "generated_tokens": n_tokens,
+            "tokens_per_s": (
+                round(n_tokens / total, 2) if total > 0 else 0.0
+            ),
+            "prefill_p50_ms": _pct(prefill, 50),
+            "prefill_p99_ms": _pct(prefill, 99),
+            "decode_p50_ms": _pct(decode, 50),
+            "decode_p99_ms": _pct(decode, 99),
+        }
+        return out
+
+
+def _pct(xs: np.ndarray, q: float):
+    if xs.size == 0:
+        return None
+    return round(float(np.percentile(xs, q)) * 1e3, 3)
+
+
+__all__ = [
+    "FinishedSequence",
+    "Request",
+    "Scheduler",
+    "Sequence",
+]

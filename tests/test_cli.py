@@ -460,6 +460,104 @@ def test_pipeline_schedule_flag_defaults():
         lm.main(["--pipeline-schedule", "1f1b"])  # no --pipeline-stages
 
 
+def test_serve_cli_replicated():
+    """The serving CLI end-to-end: synthetic trace in, per-request
+    latencies + aggregate tokens/sec / p50/p99 legs out, slot
+    recycling under admission pressure (6 requests over 2 slots)."""
+    from distributed_model_parallel_tpu.cli import serve
+
+    result = serve.main([
+        "--dim", "16", "--layers", "2", "--heads", "4",
+        "--ffn-dim", "32", "--vocab-size", "61",
+        "--num-slots", "2", "--max-len", "16", "--prefill-len", "8",
+        "--num-requests", "6", "--prompt-len-min", "2",
+        "--prompt-len-max", "6", "--max-new-tokens", "3",
+    ])
+    assert result["serving"]["requests"] == 6
+    assert result["serving"]["generated_tokens"] == 18
+    assert result["serving"]["decode_p50_ms"] is not None
+    assert len(result["requests"]) == 6
+
+
+@pytest.mark.slow
+def test_serve_cli_tp_collective_matmul():
+    """--layout tp --collective-matmul drives the full serving entry
+    point with the opted-in decode rings. `slow` (tier-1 budget);
+    tier-1 twins: tests/test_serving.py::
+    test_decode_matches_dense_tp_collective_matmul (the engine math),
+    the serve/S2/cm hlolint combo (the lowering), and
+    test_serve_cli_replicated + test_serving_flag_guards (the entry
+    point and flag surface)."""
+    from distributed_model_parallel_tpu.cli import serve
+
+    result = serve.main([
+        "--layout", "tp", "--model-shards", "4", "--collective-matmul",
+        "--dim", "16", "--layers", "2", "--heads", "4",
+        "--ffn-dim", "32", "--vocab-size", "61",
+        "--num-slots", "4", "--max-len", "16", "--prefill-len", "8",
+        "--num-requests", "4", "--prompt-len-min", "2",
+        "--prompt-len-max", "6", "--max-new-tokens", "3",
+    ])
+    assert result["serving"]["requests"] == 4
+    assert result["serving"]["collective_matmul"] is True
+
+
+@pytest.mark.slow
+def test_serve_cli_sp():
+    """--layout sp drives the full serving entry point: ring-attention
+    prefill + online-softmax decode over the 'seq'-sharded cache.
+    `slow` (tier-1 budget); tier-1 twins: tests/test_serving.py::
+    test_decode_matches_dense_sp (the engine math) and
+    test_serve_cli_replicated (the entry point)."""
+    from distributed_model_parallel_tpu.cli import serve
+
+    result = serve.main([
+        "--layout", "sp", "--seq-shards", "4",
+        "--dim", "16", "--layers", "2", "--heads", "4",
+        "--ffn-dim", "32", "--vocab-size", "61",
+        "--num-slots", "4", "--max-len", "16", "--prefill-len", "8",
+        "--num-requests", "4", "--prompt-len-min", "2",
+        "--prompt-len-max", "6", "--max-new-tokens", "3",
+    ])
+    assert result["serving"]["requests"] == 4
+    assert result["serving"]["layout"] == "sp"
+
+
+def test_serving_flag_guards():
+    """Serving rejects training-side flags and inconsistent layouts
+    loudly, BEFORE building meshes/engines (cli/common.
+    check_serving_args): a launch line pasted from the training CLIs
+    must fail with an explanation, not silently do nothing."""
+    from distributed_model_parallel_tpu.cli import serve
+
+    args = serve.build_parser().parse_args([])
+    assert args.layout == "replicated"
+    assert not args.collective_matmul
+    with pytest.raises(SystemExit):  # serving has no stage wires
+        serve.main(["--pipeline-stages", "2"])
+    with pytest.raises(SystemExit):  # no backward to reduce
+        serve.main(["--grad-reduction", "bucketed"])
+    with pytest.raises(SystemExit):  # even typed at the default value
+        serve.main(["--bucket-mb", "25"])
+    with pytest.raises(SystemExit):  # overlap is a backward knob
+        serve.main(["--overlap-stages", "2"])
+    with pytest.raises(SystemExit):  # serving meshes are model/seq
+        serve.main(["--dcn-slices", "2"])
+    with pytest.raises(SystemExit):  # rings need the tp layout
+        serve.main(["--collective-matmul"])
+    with pytest.raises(SystemExit):  # tp with 1 shard = replicated
+        serve.main(["--layout", "tp"])
+    with pytest.raises(SystemExit):  # sp with 1 shard = replicated
+        serve.main(["--layout", "sp"])
+    with pytest.raises(SystemExit):  # one layout per run
+        serve.main(["--layout", "sp", "--seq-shards", "2",
+                    "--model-shards", "2"])
+    with pytest.raises(SystemExit):  # shards without a layout
+        serve.main(["--model-shards", "4"])
+    with pytest.raises(SystemExit):  # prompts must fit the prefill pad
+        serve.main(["--prompt-len-max", "200", "--prefill-len", "64"])
+
+
 def test_reference_split_builds_stages():
     """The ws=4 reference boundaries produce 4 composable stages
     (structural check; the compiled path runs in test_pipeline.py)."""

@@ -269,6 +269,60 @@ def test_cm_monolithic_permute_only_kernel_is_clean():
     ) == []
 
 
+# ------------------------------------------------- serve-decode-ring
+
+
+def serve_target(**kw):
+    base = dict(
+        name="t", engine="serve", collective_matmul=True,
+        data_axes=(), ici_axis=None, ici_size=1,
+        cm_axis="model", cm_size=4, serve_decode_permutes=2,
+    )
+    base.update(kw)
+    return LintTarget(**base)
+
+
+@pytest.mark.hlo_rule("serve-decode-ring", "positive")
+def test_serve_decode_ring_fires_on_short_chain_and_gather():
+    # One tagged permute where two are pinned, plus a surviving
+    # monolithic all-gather over the TP axis: both findings fire.
+    hlo = module([
+        perm("cp0", "p", M4_PAIRS, tag="serve_ring"),
+        "%ag = f32[64]{0} all-gather(f32[64]{0} %p), "
+        "replica_groups={{0,1,2,3}}, dimensions={0}, "
+        "use_global_device_ids=true",
+    ])
+    found = check("serve-decode-ring", serve_target(), hlo, MESH_M4)
+    msgs = "; ".join(f.message for f in found)
+    assert "expected exactly 2" in msgs
+    assert "monolithic all-gather" in msgs
+
+
+@pytest.mark.hlo_rule("serve-decode-ring", "negative")
+def test_serve_decode_ring_tagged_chain_is_clean():
+    # The pinned tagged count, plus an UNTAGGED permute (GSPMD's own
+    # resharding traffic) that must not be counted against the pin.
+    hlo = module([
+        perm("cp0", "p", M4_PAIRS, tag="serve_ring"),
+        perm("cp1", "cp0", M4_PAIRS, tag="serve_ring"),
+        perm("cp2", "cp1", M4_PAIRS),
+    ])
+    assert check(
+        "serve-decode-ring", serve_target(), hlo, MESH_M4
+    ) == []
+
+
+def test_serve_decode_ring_missing_expectation_is_a_finding():
+    """An opted-in serving combo whose builder forgot the permute
+    expectation must surface, not silently pass."""
+    hlo = module([perm("cp0", "p", M4_PAIRS, tag="serve_ring")])
+    found = check(
+        "serve-decode-ring",
+        serve_target(serve_decode_permutes=None), hlo, MESH_M4,
+    )
+    assert found and "was not checked" in found[0].message
+
+
 # --------------------------------------------------- fsdp-at-rest-sharded
 
 

@@ -34,6 +34,9 @@ TIER1_COMBOS = [
     # op-level exact S-1 kernels
     Combo("cm_ag", 4),
     Combo("cm_rs", 4),
+    # serving decode rings: exact tagged 4L(S-1) chain, no monolithic
+    # all-gather on the opted-in step (serve-decode-ring)
+    Combo("serve", 2, collective_matmul=True),
 ]
 
 
