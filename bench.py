@@ -1066,6 +1066,158 @@ def run_child_serving(max_devices: int, platform: str = "cpu") -> None:
     print(json.dumps(out, indent=2))
 
 
+def run_child_checkpoint(max_devices: int, platform: str = "cpu") -> None:
+    """Checkpoint-save microbench (`checkpointing/`) — what the train
+    loop actually pays per snapshot, in three lowerings over an FSDP
+    (1/N-sharded) state:
+
+      * legacy_sync   — the reference-shaped path: gather every leaf to
+                        host (per-leaf process_allgather on a real
+                        multi-host mesh), one .npz from host 0
+                        (`training/checkpoint.save_checkpoint`);
+      * sharded_sync  — each process writes only its addressable
+                        chunks + the manifest, inline
+                        (`checkpointing.save_sharded`);
+      * sharded_async — same files from the background writer thread:
+                        the step path pays only the device->host
+                        snapshot (step_blocked_ms), the I/O overlaps
+                        the next steps (save_wall_ms = until wait()).
+
+    Columns per row: save_wall_ms, step_blocked_ms (how long the call
+    holds the train loop), bytes_per_host (actual file bytes this
+    process wrote). One partial JSON line per completed row (a wedge
+    mid-sweep keeps the finished legs), then the table. Single-process
+    both formats write the same total bytes; on a real pod the sharded
+    rows split them 1/N per host and skip the gather entirely."""
+    if max_devices < 2:
+        raise ValueError(f"--max-devices must be >= 2, got {max_devices}")
+    if platform == "cpu":
+        from distributed_model_parallel_tpu.runtime.platform import force_cpu
+
+        force_cpu(max_devices)
+
+    import glob
+    import shutil
+    import tempfile
+
+    import jax
+    import numpy as np
+
+    from distributed_model_parallel_tpu.checkpointing import (
+        AsyncCheckpointer,
+        restore_checkpoint,
+        save_sharded,
+    )
+    from distributed_model_parallel_tpu.models import layers as L
+    from distributed_model_parallel_tpu.parallel.fsdp import FSDPEngine
+    from distributed_model_parallel_tpu.runtime.mesh import (
+        MeshSpec,
+        make_mesh,
+    )
+    from distributed_model_parallel_tpu.training.checkpoint import (
+        save_checkpoint,
+    )
+    from distributed_model_parallel_tpu.training.optim import SGD
+
+    devices = jax.devices("cpu") if platform == "cpu" else jax.devices()
+    size = min(max_devices, len(devices))
+    if size % 2:
+        size -= 1
+    mesh = make_mesh(MeshSpec(data=size), devices=devices[:size])
+    # A few-MB MLP so the file I/O is measurable without drowning the
+    # CPU harness (SGD momentum doubles the state bytes).
+    model = L.sequential(
+        L.linear(256, 1024), L.relu(),
+        L.linear(1024, 1024), L.relu(),
+        L.linear(1024, 10),
+    )
+    engine = FSDPEngine(model, SGD(), mesh, donate=False)
+    state = engine.init_state(jax.random.PRNGKey(0))
+    state_mb = sum(
+        leaf.nbytes for leaf in jax.tree_util.tree_leaves(state)
+    ) / 1e6
+    workdir = tempfile.mkdtemp(prefix="ckpt_microbench_")
+
+    def dir_bytes(d):
+        return sum(
+            os.path.getsize(f)
+            for f in glob.glob(os.path.join(d, "*"))
+            if os.path.isfile(f)
+        )
+
+    iters = 5
+    rows = []
+    try:
+        for mode in ("legacy_sync", "sharded_sync", "sharded_async"):
+            d = os.path.join(workdir, mode)
+            blocked, wall = [], []
+            writer = (
+                AsyncCheckpointer() if mode == "sharded_async" else None
+            )
+            for i in range(iters):
+                t0 = time.perf_counter()
+                if mode == "legacy_sync":
+                    save_checkpoint(
+                        d, engine.to_canonical(state), acc=0.0, epoch=i
+                    )
+                    t1 = t2 = time.perf_counter()
+                else:
+                    save_sharded(
+                        d, state, acc=0.0, epoch=i, writer=writer
+                    )
+                    t1 = time.perf_counter()
+                    if writer is not None:
+                        writer.wait()
+                    t2 = time.perf_counter()
+                blocked.append((t1 - t0) * 1e3)
+                wall.append((t2 - t0) * 1e3)
+            row = {
+                "mode": mode,
+                "axis_size": size,
+                "save_wall_ms": round(float(np.median(wall)), 3),
+                "step_blocked_ms": round(float(np.median(blocked)), 3),
+                "bytes_per_host": dir_bytes(d),
+            }
+            rows.append(row)
+            log(f"{mode}: wall {row['save_wall_ms']}ms, blocked "
+                f"{row['step_blocked_ms']}ms, "
+                f"{row['bytes_per_host'] / 1e6:.2f} MB/host")
+            # Per-leg partial line (same convention as the other sweeps).
+            print(json.dumps({"leg": row, "partial": True}), flush=True)
+        # Sanity: the async files must restore what the state holds.
+        template = jax.tree_util.tree_map(
+            np.asarray, jax.device_get(state)
+        )
+        restored, _, _ = restore_checkpoint(
+            os.path.join(workdir, "sharded_async"), template
+        )
+        for a, b in zip(
+            jax.tree_util.tree_leaves(template),
+            jax.tree_util.tree_leaves(restored),
+        ):
+            assert np.array_equal(np.asarray(a), np.asarray(b))
+    finally:
+        shutil.rmtree(workdir, ignore_errors=True)
+
+    out = {
+        "checkpoint_microbench": rows,
+        "platform": jax.devices()[0].platform,
+        "device_kind": jax.devices()[0].device_kind,
+        "axis_size": size,
+        "state_mb": round(state_mb, 2),
+        "iters_per_mode": iters,
+    }
+    if jax.devices()[0].platform == "cpu":
+        out["note"] = (
+            "single-process virtual mesh: both formats write the same "
+            "total bytes from one host and the legacy gather is a "
+            "device_get, so the async step_blocked_ms column is the "
+            "honest signal here; on a real pod the sharded rows write "
+            "1/N per host and skip the per-leaf process_allgather"
+        )
+    print(json.dumps(out, indent=2))
+
+
 # -------------------------------------------------------------- parent side
 
 
@@ -1454,6 +1606,14 @@ if __name__ == "__main__":
              "--max-devices",
     )
     parser.add_argument(
+        "--checkpoint-microbench", action="store_true",
+        help="print a legacy-sync vs sharded-sync vs sharded-async "
+             "checkpoint-save table (save wall-ms, step-blocked-ms, "
+             "bytes/host — checkpointing/) instead of the single "
+             "benchmark line; devices from --scaling-platform / "
+             "--max-devices",
+    )
+    parser.add_argument(
         "--child", action="store_true",
         help="internal: run a measurement in-process (spawned by main)",
     )
@@ -1472,6 +1632,9 @@ if __name__ == "__main__":
     parser.add_argument("--child-serving", action="store_true",
                         help="internal: run the serving microbench "
                              "in-process")
+    parser.add_argument("--child-checkpoint", action="store_true",
+                        help="internal: run the checkpoint microbench "
+                             "in-process")
     parser.add_argument("--child-model", default="mobilenetv2")
     parser.add_argument("--child-batch", type=int, default=512)
     parser.add_argument("--child-dtypes", default="bfloat16,float32")
@@ -1481,14 +1644,14 @@ if __name__ == "__main__":
 
     n_sweeps = sum(
         (args.scaling, args.cm_microbench, args.reducer_microbench,
-         args.serving_microbench)
+         args.serving_microbench, args.checkpoint_microbench)
     )
     if n_sweeps > 1:
         parser.error(
             "--scaling / --cm-microbench / --reducer-microbench / "
-            "--serving-microbench are mutually exclusive (one sweep "
-            "per invocation; running several would silently drop "
-            "tables)"
+            "--serving-microbench / --checkpoint-microbench are "
+            "mutually exclusive (one sweep per invocation; running "
+            "several would silently drop tables)"
         )
 
     if args.child_probe:
@@ -1510,6 +1673,9 @@ if __name__ == "__main__":
         sys.exit(0)
     if args.child_serving:
         run_child_serving(args.max_devices, args.scaling_platform)
+        sys.exit(0)
+    if args.child_checkpoint:
+        run_child_checkpoint(args.max_devices, args.scaling_platform)
         sys.exit(0)
 
     def on_alarm(signum, frame):
@@ -1551,12 +1717,19 @@ if __name__ == "__main__":
                      "--scaling-platform", args.scaling_platform],
                     env, "reducer_microbench",
                 )
-            else:
+            elif args.serving_microbench:
                 _run_sweep_child(
                     ["--child-serving",
                      "--max-devices", str(args.max_devices),
                      "--scaling-platform", args.scaling_platform],
                     env, "serving_microbench",
+                )
+            else:
+                _run_sweep_child(
+                    ["--child-checkpoint",
+                     "--max-devices", str(args.max_devices),
+                     "--scaling-platform", args.scaling_platform],
+                    env, "checkpoint_microbench",
                 )
         else:
             main()

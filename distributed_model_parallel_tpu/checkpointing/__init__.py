@@ -1,0 +1,57 @@
+"""checkpointing/ — sharded parallel saves, async writes, resharding restore.
+
+Supersedes the monolithic gather-to-host-0 path of
+`training/checkpoint.py` (which remains the LEGACY format, still
+written by default and always readable) with the three layers a
+production training stack needs under preemption:
+
+  sharded save       each process writes only its locally-addressable
+                     chunks (`{name}.s{id}.shard{p}.npz`) + a JSON
+                     manifest — no `process_allgather` anywhere on the
+                     save path (save.py; ZeRO, Rajbhandari SC'20).
+  async writer       one device->host snapshot on the step path, file
+                     I/O on a background thread; errors surface at the
+                     next save or `fit()` exit, a mid-write crash never
+                     clobbers the previous manifest (writer.py).
+  resharding restore chunk-reassembled canonical form re-sliced for the
+                     CURRENT mesh — an S=4 FSDP checkpoint loads onto
+                     S=8, S=2 or a hybrid dcn×ici mesh (restore.py;
+                     Megatron SC'21), and `elastic_fit` hands the saved
+                     topology to `make_trainer` for genuine elasticity.
+
+Opt in through `TrainerConfig(checkpoint_format="sharded",
+async_save=True)` or `--checkpoint-format sharded --async-save` on the
+training CLIs. INTERNALS.md §10 documents the on-disk anatomy.
+"""
+
+from distributed_model_parallel_tpu.checkpointing.manifest import (
+    Manifest,
+    load_manifest,
+    manifest_exists,
+    manifest_path,
+)
+from distributed_model_parallel_tpu.checkpointing.restore import (
+    checkpoint_metadata,
+    restore_checkpoint,
+    restore_subtree,
+    saved_topology,
+)
+from distributed_model_parallel_tpu.checkpointing.save import save_sharded
+from distributed_model_parallel_tpu.checkpointing.writer import (
+    AsyncCheckpointer,
+    SaveHandle,
+)
+
+__all__ = [
+    "AsyncCheckpointer",
+    "Manifest",
+    "checkpoint_metadata",
+    "SaveHandle",
+    "load_manifest",
+    "manifest_exists",
+    "manifest_path",
+    "restore_checkpoint",
+    "restore_subtree",
+    "save_sharded",
+    "saved_topology",
+]

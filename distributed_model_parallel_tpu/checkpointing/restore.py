@@ -1,0 +1,304 @@
+"""Resharding restore — any saved layout onto any current mesh.
+
+`restore_checkpoint` is the unified entry the trainer calls: a
+directory holding a sharded manifest restores through chunk reassembly;
+anything else falls back to the legacy single-`.npz` reader
+(`training/checkpoint.restore_checkpoint`) — same signature, same
+return, so old checkpoints keep working unchanged.
+
+Resharding is the point: each leaf is reassembled to its FULL host
+array from whatever shard layout the manifest records (S=4 FSDP, TP
+columns, a 2×2 dcn×ici hybrid ...) — the canonical form every engine
+already restores through — and the engine's `from_canonical` /
+`device_put(state, state_shardings)` re-slices it for the CURRENT mesh.
+An S=4 checkpoint therefore loads onto S=8, S=2, or a hybrid mesh with
+no format conversion step (Megatron SC'21's restore-time repartitioning
+argument; PAPERS.md). Bit-exactness of the round trip is pinned in
+tests/test_checkpoint_sharded.py.
+
+Multi-process: same agreement protocol as the legacy reader — hosts
+that see the files read them; hosts that don't build placeholders; all
+agree on host-0's success before host-0's read is broadcast. The two
+readers' broadcast sequences are IDENTICAL (ok flag, then the state
+tuple), so hosts with per-host disks rendezvous even when only host 0
+can see which format is on disk.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Optional, Tuple
+
+import jax
+import numpy as np
+
+from distributed_model_parallel_tpu.checkpointing.manifest import (
+    Manifest,
+    load_manifest,
+    manifest_exists,
+    manifest_path,
+)
+
+
+def _training_checkpoint():
+    """Lazy import of the legacy reader: training/__init__ re-exports
+    the Trainer, which imports THIS package — a module-level import
+    here would close the cycle."""
+    from distributed_model_parallel_tpu.training import checkpoint
+
+    return checkpoint
+
+
+def _template_shape_dtype(leaf):
+    shape = tuple(getattr(leaf, "shape", np.shape(leaf)))
+    dtype = getattr(leaf, "dtype", None) or np.asarray(leaf).dtype
+    return shape, dtype
+
+
+def _assemble_leaf(
+    directory: str, manifest: Manifest, key: str, want_shape, want_dtype,
+    npz_cache: dict, name: str = "ckpt",
+) -> np.ndarray:
+    rec = manifest.leaves.get(key)
+    if rec is None:
+        raise KeyError(
+            f"sharded checkpoint at {manifest_path(directory, name)} is "
+            f"missing leaf '{key}' — model structure changed since save"
+        )
+    if tuple(rec.shape) != tuple(want_shape):
+        raise ValueError(
+            f"checkpoint leaf '{key}' has shape {tuple(rec.shape)}, "
+            f"expected {tuple(want_shape)}"
+        )
+    arr = np.empty(rec.shape, dtype=np.dtype(rec.dtype))
+    for ch in rec.chunks:
+        fname = manifest.shards[ch.file]
+        if fname not in npz_cache:
+            path = os.path.join(directory, fname)
+            if not os.path.isfile(path):
+                raise FileNotFoundError(
+                    f"manifest references shard file {fname!r} which is "
+                    f"absent from {directory} — a committed save never "
+                    "leaves this state; was the directory partially "
+                    "copied or hand-pruned?"
+                )
+            npz_cache[fname] = np.load(path)
+        data = npz_cache[fname][ch.key]
+        region = tuple(
+            slice(s, s + n) for s, n in zip(ch.start, ch.shape)
+        )
+        arr[region] = data
+    # NOT ascontiguousarray: this numpy promotes 0-d inputs to (1,)
+    # there, and np.empty is contiguous already.
+    return arr.astype(want_dtype, copy=False)
+
+
+def _read_sharded(
+    directory: str, name: str, leaves_with_paths
+) -> Tuple[list, float, int]:
+    manifest = load_manifest(directory, name)
+    _path_str = _training_checkpoint()._path_str
+    npz_cache: dict = {}
+    try:
+        new_leaves = []
+        for path, leaf in leaves_with_paths:
+            shape, dtype = _template_shape_dtype(leaf)
+            new_leaves.append(_assemble_leaf(
+                directory, manifest, _path_str(path), shape, dtype,
+                npz_cache, name,
+            ))
+    finally:
+        for f in npz_cache.values():
+            f.close()
+    return new_leaves, manifest.acc, manifest.epoch
+
+
+def restore_checkpoint(
+    directory: str,
+    train_state_like: Any,
+    *,
+    name: str = "ckpt",
+) -> Tuple[Any, float, int]:
+    """Unified restore: sharded manifest when present, legacy `.npz`
+    otherwise — `(state, best_acc, start_epoch)` either way, into the
+    structure/shapes/dtypes of `train_state_like` (module docstring)."""
+    if not manifest_exists(directory, name):
+        return _training_checkpoint().restore_checkpoint(
+            directory, train_state_like, name=name
+        )
+    leaves_with_paths, treedef = jax.tree_util.tree_flatten_with_path(
+        train_state_like
+    )
+    acc, epoch = 0.0, 0
+    error: Optional[Exception] = None
+    new_leaves = None
+    try:
+        new_leaves, acc, epoch = _read_sharded(
+            directory, name, leaves_with_paths
+        )
+    except Exception as e:  # noqa: BLE001 — agreed + re-raised below
+        error = e
+    if new_leaves is None:
+        new_leaves = [
+            np.zeros(*_template_shape_dtype(leaf))
+            for _, leaf in leaves_with_paths
+        ]
+    state = jax.tree_util.tree_unflatten(treedef, new_leaves)
+
+    if jax.process_count() > 1:
+        # Same two-broadcast agreement as the legacy reader (module
+        # docstring): non-zero-host failures fall to the placeholder
+        # path and adopt host-0's read; host-0 failures surface on
+        # every host together, never a one-sided raise into a hanging
+        # broadcast.
+        from jax.experimental import multihost_utils
+
+        host0_failed = error is not None and jax.process_index() == 0
+        ok = multihost_utils.broadcast_one_to_all(
+            np.int32(0 if host0_failed else 1)
+        )
+        if not int(ok):
+            raise error if error is not None else RuntimeError(
+                "sharded checkpoint restore failed on host 0"
+            )
+        state, acc_ep = multihost_utils.broadcast_one_to_all(
+            (state, (np.float32(acc), np.int32(epoch)))
+        )
+        acc, epoch = float(acc_ep[0]), int(acc_ep[1])
+    elif error is not None:
+        raise error
+    return state, acc, epoch
+
+
+def restore_subtree(
+    directory: str,
+    template: Any,
+    *,
+    name: str = "ckpt",
+    prefix: str = "params",
+) -> Tuple[Any, dict]:
+    """Restore ONE subtree of a saved TrainState (e.g. just `params`
+    for serving) from either format, plus the checkpoint's metadata
+    dict (acc/epoch/extra — the serve CLI's model-config guard reads
+    `extra`). `template` gives the subtree's structure; saved keys are
+    looked up under `{prefix}/{leaf path}`."""
+    leaves_with_paths, treedef = jax.tree_util.tree_flatten_with_path(
+        template
+    )
+    _path_str = _training_checkpoint()._path_str
+    meta: dict = {}
+    if manifest_exists(directory, name):
+        manifest = load_manifest(directory, name)
+        meta = {
+            "acc": manifest.acc, "epoch": manifest.epoch,
+            "format": "sharded", "mesh_axes": dict(manifest.mesh_axes),
+        }
+        if manifest.extra:
+            meta.update(manifest.extra)
+        npz_cache: dict = {}
+        try:
+            new_leaves = []
+            for path, leaf in leaves_with_paths:
+                shape, dtype = _template_shape_dtype(leaf)
+                new_leaves.append(_assemble_leaf(
+                    directory, manifest,
+                    f"{prefix}/{_path_str(path)}", shape, dtype,
+                    npz_cache, name,
+                ))
+        finally:
+            for f in npz_cache.values():
+                f.close()
+    else:
+        import json
+
+        npz_path = os.path.join(directory, f"{name}.npz")
+        if not os.path.isfile(npz_path):
+            raise FileNotFoundError(
+                f"Error: no checkpoint found at {npz_path} (nor a "
+                f"{name}.manifest.json)"
+            )
+        with np.load(npz_path) as data:
+            arrays = {k: data[k] for k in data.files}
+        new_leaves = []
+        for path, leaf in leaves_with_paths:
+            key = f"{prefix}/{_path_str(path)}"
+            if key not in arrays:
+                raise KeyError(
+                    f"checkpoint at {npz_path} is missing leaf "
+                    f"'{key}' — model structure changed since save"
+                )
+            shape, dtype = _template_shape_dtype(leaf)
+            arr = arrays[key]
+            if tuple(arr.shape) != shape:
+                raise ValueError(
+                    f"checkpoint leaf '{key}' has shape "
+                    f"{tuple(arr.shape)}, expected {shape}"
+                )
+            new_leaves.append(arr.astype(dtype))
+        meta_path = os.path.join(directory, f"{name}.json")
+        if os.path.isfile(meta_path):
+            with open(meta_path) as f:
+                meta = json.load(f)
+        meta["format"] = "legacy"
+    return jax.tree_util.tree_unflatten(treedef, new_leaves), meta
+
+
+def checkpoint_metadata(directory: str, name: str = "ckpt") -> dict:
+    """acc / epoch / extra metadata of either checkpoint format WITHOUT
+    touching array data — what `cli/serve.py --checkpoint` reads to
+    fail fast on a model-config mismatch before building an engine.
+    Raises FileNotFoundError when no checkpoint of either format is
+    present."""
+    import json
+
+    if manifest_exists(directory, name):
+        m = load_manifest(directory, name)
+        meta = {
+            "acc": m.acc, "epoch": m.epoch, "format": "sharded",
+            "mesh_axes": dict(m.mesh_axes),
+        }
+        if m.extra:
+            meta.update(m.extra)
+        return meta
+    npz_path = os.path.join(directory, f"{name}.npz")
+    if not os.path.isfile(npz_path):
+        raise FileNotFoundError(
+            f"Error: no checkpoint found at {npz_path} (nor a "
+            f"{name}.manifest.json)"
+        )
+    meta = {"format": "legacy"}
+    meta_path = os.path.join(directory, f"{name}.json")
+    if os.path.isfile(meta_path):
+        with open(meta_path) as f:
+            meta.update(json.load(f))
+    return meta
+
+
+def saved_topology(
+    directory: str, name: str = "ckpt"
+) -> Optional[dict]:
+    """The mesh factorization a sharded checkpoint was taken at —
+    `{"mesh_axes": {...}, "process_count": n, "epoch": e}` — or None
+    for legacy/absent checkpoints (which record no topology). This is
+    what `elastic_fit` hands to `make_trainer` so a restart may rebuild
+    onto a RESIZED mesh and restore through the canonical form."""
+    if not manifest_exists(directory, name):
+        return None
+    try:
+        m = load_manifest(directory, name)
+    except (OSError, ValueError, KeyError):
+        return None
+    return {
+        "mesh_axes": dict(m.mesh_axes),
+        "process_count": m.process_count,
+        "epoch": m.epoch,
+        "format": "sharded",
+    }
+
+
+__all__ = [
+    "checkpoint_metadata",
+    "restore_checkpoint",
+    "restore_subtree",
+    "saved_topology",
+]

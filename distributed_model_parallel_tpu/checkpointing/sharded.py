@@ -1,0 +1,140 @@
+"""Chunk planning for sharded saves — who writes which slice of which leaf.
+
+The ZeRO discipline (Rajbhandari et al., SC'20 — PAPERS.md): every
+process persists exactly the shards it already holds in local memory,
+so the save path contains NO cross-process gather of sharded leaves —
+the collective `process_allgather` the legacy canonical-form save pays
+per leaf is never reached (pinned in tests/test_checkpoint_sharded.py).
+
+The plan is derived from `sharding.devices_indices_map`, which is
+GLOBAL information every process computes identically without
+communication: each distinct index (slice region) of a leaf is assigned
+one OWNER — the lowest-id device holding it — and a process writes a
+chunk iff it hosts that owner device. Replicated leaves therefore
+collapse to one chunk owned by (a device of) process 0; an FSDP leaf
+sharded N-ways yields N chunks spread over the processes exactly 1/N
+each. Host-side leaves (python scalars, numpy arrays — e.g. a
+checkpoint template built off-device) fall to process 0.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import List, Optional, Tuple
+
+import jax
+import numpy as np
+
+from distributed_model_parallel_tpu.checkpointing.manifest import (
+    spec_to_json,
+)
+
+
+@dataclasses.dataclass
+class PlannedChunk:
+    """One distinct slice region of one leaf, with its global owner."""
+
+    start: Tuple[int, ...]
+    shape: Tuple[int, ...]
+    owner_process: int
+
+
+def _normalize_index(
+    index: Tuple[slice, ...], shape: Tuple[int, ...]
+) -> Tuple[Tuple[int, int], ...]:
+    """Slice tuple -> ((start, stop), ...) with open ends filled in."""
+    out = []
+    for sl, dim in zip(index, shape):
+        start = 0 if sl.start is None else int(sl.start)
+        stop = dim if sl.stop is None else int(sl.stop)
+        out.append((start, stop))
+    return tuple(out)
+
+
+def leaf_spec_json(leaf) -> list:
+    """The manifest's record of a leaf's PartitionSpec: read straight
+    off the array's NamedSharding; replicated ([]) for host leaves and
+    non-named layouts (single-device arrays)."""
+    sharding = getattr(leaf, "sharding", None)
+    spec = getattr(sharding, "spec", None)
+    if spec is None:
+        return []
+    return spec_to_json(spec)
+
+
+def plan_leaf_chunks(leaf) -> List[PlannedChunk]:
+    """The GLOBAL chunk plan for one leaf — identical on every process
+    (module docstring). Sorted by start offsets so chunk ordinals are
+    stable across processes and restarts."""
+    if not isinstance(leaf, jax.Array):
+        arr = np.asarray(leaf)
+        return [PlannedChunk((0,) * arr.ndim, tuple(arr.shape), 0)]
+    shape = tuple(leaf.shape)
+    owners = {}
+    for dev, index in leaf.sharding.devices_indices_map(shape).items():
+        key = _normalize_index(index, shape)
+        cur = owners.get(key)
+        if cur is None or dev.id < cur.id:
+            owners[key] = dev
+    plan = [
+        PlannedChunk(
+            start=tuple(b[0] for b in key),
+            shape=tuple(b[1] - b[0] for b in key),
+            owner_process=int(dev.process_index),
+        )
+        for key, dev in owners.items()
+    ]
+    plan.sort(key=lambda c: c.start)
+    return plan
+
+
+def local_chunk_data(
+    leaf, chunk: PlannedChunk
+) -> Optional[np.ndarray]:
+    """Host numpy for a chunk THIS process owns (None otherwise). The
+    device->host copy here is the snapshot's only transfer — it moves
+    1/N of the leaf, never the gathered whole."""
+    if chunk.owner_process != jax.process_index():
+        return None
+    if not isinstance(leaf, jax.Array):
+        return np.asarray(leaf)
+    want = tuple(
+        (s, s + n) for s, n in zip(chunk.start, chunk.shape)
+    )
+    for sh in leaf.addressable_shards:
+        if _normalize_index(sh.index, tuple(leaf.shape)) == want:
+            return np.asarray(sh.data)
+    raise RuntimeError(
+        f"chunk {want} planned for process {chunk.owner_process} has no "
+        f"addressable shard on it — sharding/device mapping disagree "
+        f"(leaf shape {tuple(leaf.shape)}, sharding {leaf.sharding})"
+    )
+
+
+def tree_mesh_axes(tree) -> Tuple[dict, int]:
+    """(axis name -> size, process_count) of the mesh the tree's arrays
+    live on — the manifest's topology record, later handed to
+    `elastic_fit`'s `make_trainer` for resize decisions. Falls back to
+    an empty dict for host-only trees."""
+    for leaf in jax.tree_util.tree_leaves(tree):
+        sharding = getattr(leaf, "sharding", None)
+        mesh = getattr(sharding, "mesh", None)
+        if mesh is not None and getattr(mesh, "axis_names", None):
+            try:
+                axes = {
+                    name: int(mesh.shape[name])
+                    for name in mesh.axis_names
+                }
+            except Exception:  # AbstractMesh etc. — no concrete shape
+                continue
+            return axes, jax.process_count()
+    return {}, jax.process_count()
+
+
+__all__ = [
+    "PlannedChunk",
+    "leaf_spec_json",
+    "local_chunk_data",
+    "plan_leaf_chunks",
+    "tree_mesh_axes",
+]

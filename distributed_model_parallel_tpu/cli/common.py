@@ -434,6 +434,46 @@ def check_overlapped_model(name: str, overlap_stages: int = 0) -> None:
         )
 
 
+def add_checkpoint_flags(parser: argparse.ArgumentParser) -> None:
+    """The checkpoint-format surface shared by the training CLIs
+    (`checkpointing/`): sharded parallel saves, async off-step-path
+    writes, resharding restore."""
+    parser.add_argument(
+        "--checkpoint-dir", default="./checkpoint",
+        help="checkpoint directory (reference: ./checkpoint)",
+    )
+    parser.add_argument(
+        "--checkpoint-format", default="legacy",
+        choices=("legacy", "sharded"),
+        help="legacy = one .npz gathered to host 0 (the reference's "
+             "shape); sharded = each process writes only its "
+             "locally-addressable shards + a JSON manifest "
+             "(ZeRO-style parallel save — no cross-process gather on "
+             "the save path; restore reshards onto the current mesh, "
+             "so an elastic restart may resize). Restore auto-detects "
+             "either format",
+    )
+    parser.add_argument(
+        "--async-save", action="store_true",
+        help="move checkpoint file I/O off the step path (sharded "
+             "format only): one device->host snapshot, then a "
+             "background writer thread; write errors surface at the "
+             "next save or at fit() exit, never silently",
+    )
+
+
+def check_checkpoint_args(args) -> None:
+    """Startup-time validation of the shared checkpoint flags (the
+    Trainer enforces the same, but only after datasets/meshes are
+    built)."""
+    if args.async_save and args.checkpoint_format != "sharded":
+        raise SystemExit(
+            "--async-save moves the sharded writer off the step path; "
+            "it requires --checkpoint-format sharded (the legacy "
+            "format gathers to host 0 synchronously by design)"
+        )
+
+
 def check_serving_args(args) -> None:
     """Startup-time validation of the serving CLI surface
     (`cli/serve.py`), mirroring the other `check_*_args` guards: fail

@@ -25,12 +25,14 @@ import argparse
 import jax
 
 from distributed_model_parallel_tpu.cli.common import (
+    add_checkpoint_flags,
     add_common_tpu_flags,
     add_grad_reduction_flags,
     build_loaders,
     build_model,
     build_optimizer,
     check_batch_divisibility,
+    check_checkpoint_args,
     check_grad_reduction_args,
     compute_dtype_from_flag,
 )
@@ -94,6 +96,7 @@ def build_parser() -> argparse.ArgumentParser:
                              "all-gather/reduce-scatter (same math; "
                              "transformer-family models)")
     add_grad_reduction_flags(parser)
+    add_checkpoint_flags(parser)
     parser.add_argument("--max-restarts", default=0, type=int,
                         help="fail-fast elastic mode: restart from the "
                              "per-epoch checkpoint up to N times on "
@@ -134,6 +137,7 @@ def main(argv=None) -> dict:
         if not os.path.exists(args.finetune):
             raise SystemExit(f"--finetune: no such file {args.finetune!r}")
     check_grad_reduction_args(args)
+    check_checkpoint_args(args)
     if args.grad_reduction != "monolithic" and args.engine not in (
         "ddp", "fsdp"
     ):
@@ -267,7 +271,7 @@ def main(argv=None) -> dict:
         engine = DataParallelEngine(
             model, opt, mesh, compute_dtype=cdt, input_transform=itf
         )
-    checkpoint_dir = "./checkpoint"  # single source of truth (cfg + probes)
+    checkpoint_dir = args.checkpoint_dir  # one source of truth (cfg + probes)
 
     def _restart_can_resume() -> bool:
         """Host-0-authoritative: checkpoints are written by host 0 only,
@@ -304,6 +308,8 @@ def main(argv=None) -> dict:
             steps_per_dispatch=args.steps_per_dispatch,
             profile_dir=args.profile_dir,
             save_last=args.max_restarts > 0,
+            checkpoint_format=args.checkpoint_format,
+            async_save=args.async_save,
         )
         trainer = Trainer(engine, train, val, cfg, rng=jax.random.PRNGKey(0))
         if args.finetune and not resume:
@@ -335,7 +341,10 @@ def main(argv=None) -> dict:
             elastic_fit,
         )
 
-        return elastic_fit(make_trainer, max_restarts=args.max_restarts)
+        return elastic_fit(
+            make_trainer, max_restarts=args.max_restarts,
+            checkpoint_dir=checkpoint_dir,
+        )
     return make_trainer(False).fit()
 
 

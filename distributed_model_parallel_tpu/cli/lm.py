@@ -28,9 +28,11 @@ import argparse
 import jax
 
 from distributed_model_parallel_tpu.cli.common import (
+    add_checkpoint_flags,
     add_grad_reduction_flags,
     build_optimizer,
     check_batch_divisibility,
+    check_checkpoint_args,
     check_grad_reduction_args,
     check_pipeline_schedule_args,
     compute_dtype_from_flag,
@@ -109,6 +111,7 @@ def build_parser() -> argparse.ArgumentParser:
                         "(same math; requires --ffn-dim divisible by "
                         "--seq-shards)")
     add_grad_reduction_flags(p)
+    add_checkpoint_flags(p)
     p.add_argument("--dtype", default="float32",
                    choices=("float32", "bfloat16"))
     p.add_argument("--remat", action="store_true")
@@ -172,6 +175,7 @@ def main(argv=None) -> dict:
             f"--microbatches must be >= 1, got {args.microbatches}"
         )
     check_grad_reduction_args(args)
+    check_checkpoint_args(args)
     if args.pipeline_stages > 1 and (
         args.grad_reduction != "monolithic" or args.dcn_slices != 1
     ):
@@ -282,10 +286,24 @@ def main(argv=None) -> dict:
         t_max=max(args.epochs - args.epochs // 10, 1),
         warmup_period=max(args.epochs // 10, 1),
         log_file=args.log_file or f"lm_{args.batch_size}.txt",
+        checkpoint_dir=args.checkpoint_dir,
         resume=args.resume,
         steps_per_epoch=args.steps_per_epoch,
         steps_per_dispatch=args.steps_per_dispatch,
         profile_dir=args.profile_dir,
+        checkpoint_format=args.checkpoint_format,
+        async_save=args.async_save,
+        # Recorded in the checkpoint sidecar/manifest so `cli/serve.py
+        # --checkpoint` can fail fast, naming the exact field, when the
+        # serve flags disagree with the trained architecture.
+        checkpoint_extra={"gpt_config": {
+            "vocab_size": cfg.vocab_size,
+            "dim": cfg.dim,
+            "num_layers": cfg.num_layers,
+            "num_heads": cfg.num_heads,
+            "ffn_dim": cfg.ffn_dim,
+            "max_position": cfg.max_position,
+        }},
     )
     trainer = Trainer(engine, train, val, tcfg, rng=jax.random.PRNGKey(0))
     out = trainer.fit()

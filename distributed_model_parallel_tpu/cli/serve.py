@@ -44,8 +44,16 @@ def build_parser() -> argparse.ArgumentParser:
         description="offline autoregressive serving (continuous "
                     "batching over a slot-paged KV cache)"
     )
-    # Model (matches the lm CLI's surface; params init fresh — point a
-    # future --checkpoint at a trained canonical state to serve it).
+    # Model (matches the lm CLI's surface; params init fresh unless
+    # --checkpoint points at a trained state).
+    p.add_argument("--checkpoint", default=None, metavar="DIR",
+                   help="serve a TRAINED checkpoint: load the params "
+                        "subtree of the newest snapshot in DIR (legacy "
+                        ".npz or sharded manifest, auto-detected) "
+                        "through the canonical form into the selected "
+                        "layout; fails fast naming the mismatch when "
+                        "the checkpoint's recorded model config "
+                        "disagrees with the serve flags")
     p.add_argument("--vocab-size", default=256, type=int)
     p.add_argument("--dim", default=128, type=int)
     p.add_argument("--layers", default=4, type=int)
@@ -114,6 +122,50 @@ def synthetic_trace(args) -> list:
     return out
 
 
+# GPTConfig fields recorded by the lm CLI (checkpoint_extra) -> the
+# serve flag that controls each, for mismatch messages a user can act
+# on. max_position is driven by --max-len (the cache length IS the
+# position-table length at serve time).
+_GPT_CONFIG_FLAGS = {
+    "vocab_size": "--vocab-size",
+    "dim": "--dim",
+    "num_layers": "--layers",
+    "num_heads": "--heads",
+    "ffn_dim": "--ffn-dim",
+    "max_position": "--max-len",
+}
+
+
+def _checkpoint_guard(directory: str, name: str, cfg) -> None:
+    """Fail fast, naming the exact field, when the checkpoint's
+    recorded model config disagrees with the serve flags — BEFORE any
+    engine compiles. Checkpoints without a recorded config (e.g. saved
+    by an older run) fall through to the shape guard at load time."""
+    from distributed_model_parallel_tpu.checkpointing import (
+        checkpoint_metadata,
+    )
+
+    try:
+        meta = checkpoint_metadata(directory, name)
+    except FileNotFoundError as e:
+        raise SystemExit(str(e))
+    recorded = meta.get("gpt_config")
+    if not recorded:
+        return
+    for field, flag in _GPT_CONFIG_FLAGS.items():
+        if field not in recorded:
+            continue
+        want = getattr(cfg, field)
+        got = recorded[field]
+        if int(got) != int(want):
+            raise SystemExit(
+                f"--checkpoint {directory}: the checkpoint was trained "
+                f"with {field}={got} but the serve flags give "
+                f"{field}={want} — adjust {flag} to match the trained "
+                "model"
+            )
+
+
 def main(argv=None) -> dict:
     args = build_parser().parse_args(argv)
     check_serving_args(args)
@@ -138,6 +190,16 @@ def main(argv=None) -> dict:
         dropout_rate=0.0,
         pad_token_id=0,
     )
+    ckpt_name = None
+    if args.checkpoint:
+        # THE resume-preference rule, shared with the Trainer: serving
+        # must load the same snapshot a resumed training run would.
+        from distributed_model_parallel_tpu.training.checkpoint import (
+            newest_checkpoint_name,
+        )
+
+        ckpt_name = newest_checkpoint_name(args.checkpoint)
+        _checkpoint_guard(args.checkpoint, ckpt_name, cfg)
     shards = max(args.model_shards, args.seq_shards)
     mesh = None
     if args.layout != "replicated":
@@ -164,7 +226,39 @@ def main(argv=None) -> dict:
         collective_matmul=args.collective_matmul,
         compute_dtype=compute_dtype_from_flag(args.dtype),
     )
-    params = engine.init_params(jax.random.PRNGKey(args.seed))
+    if args.checkpoint:
+        import jax.numpy as jnp
+
+        from distributed_model_parallel_tpu.checkpointing import (
+            restore_subtree,
+        )
+
+        # The trained TrainState's `params` subtree, reassembled to the
+        # canonical (host-complete) form from either on-disk layout,
+        # then placed into THIS engine's replicated/TP/SP layout — the
+        # same dense-twin pytree every training engine produces.
+        key_aval = jax.ShapeDtypeStruct((2,), jnp.uint32)
+        p_aval, _ = jax.eval_shape(engine._full.init, key_aval)
+        try:
+            raw, meta = restore_subtree(
+                args.checkpoint, p_aval, name=ckpt_name,
+            )
+        except (FileNotFoundError, KeyError, ValueError) as e:
+            # Shape-level guard for checkpoints with no recorded
+            # config: still fails fast, naming the offending leaf.
+            raise SystemExit(
+                f"--checkpoint {args.checkpoint}: {e}"
+            )
+        params = engine.place_params(raw)
+        if jax.process_index() == 0:
+            print(
+                f"==> serving checkpoint {args.checkpoint} "
+                f"({ckpt_name}, epoch {meta.get('epoch')}, "
+                f"format {meta.get('format')})",
+                flush=True,
+            )
+    else:
+        params = engine.init_params(jax.random.PRNGKey(args.seed))
     requests = synthetic_trace(args)
     sched = engine.run(params, requests)
     report = sched.latency_report()
@@ -173,6 +267,10 @@ def main(argv=None) -> dict:
             "rid": f.rid,
             "prompt_len": f.prompt_len,
             "generated": len(f.tokens),
+            # The greedy token ids themselves: what a trained
+            # --checkpoint run is judged by (parity vs an in-process
+            # restore is pinned in tests/test_cli.py).
+            "tokens": [int(t) for t in f.tokens],
             "prefill_ms": round(f.prefill_s * 1e3, 3),
             "total_ms": round(f.total_s * 1e3, 3),
         }
@@ -181,6 +279,7 @@ def main(argv=None) -> dict:
     out = {
         "serving": {
             "layout": args.layout,
+            "checkpoint": args.checkpoint,
             "shards": shards,
             "collective_matmul": args.collective_matmul,
             "num_slots": args.num_slots,

@@ -231,13 +231,17 @@ class FSDPEngine(TensorParallelEngine):
             self.optimizer.state_shardings(param_sh, self._repl),
             self._repl,
         )
-        # The same layout as P specs, for shard_map in/out_specs.
+        # The same layout as P specs, for shard_map in/out_specs — and
+        # the `state_partition_specs` spec seam the sharded checkpoint
+        # path reads (the explicit branch skips the superclass
+        # __post_init__, so it must populate the seam itself).
         state_specs = TrainState(
             pspecs,
             jax.tree_util.tree_map(lambda _: P(), s_aval),
             self.optimizer.state_shardings(pspecs, P()),
             P(),
         )
+        self._state_pspecs = state_specs
 
         def gather_tree(tree, specs):
             """Per-leaf weight all-gather: the ZeRO-3 'materialize right

@@ -188,9 +188,20 @@ class TensorParallelEngine:
         # across steps (no per-step resharding).
         key_aval = jax.ShapeDtypeStruct((2,), jnp.uint32)
         p_aval, s_aval = jax.eval_shape(self.model.init, key_aval)
+        pspecs = self.param_specs(p_aval)
+        # The spec seam: the PartitionSpec pytree for the whole
+        # TrainState, exposed via `state_partition_specs` so checkpoint
+        # tooling and tests can read the engine's layout without
+        # reverse-engineering it from live arrays.
+        self._state_pspecs = TrainState(
+            pspecs,
+            jax.tree_util.tree_map(lambda _: P(), s_aval),
+            self.optimizer.state_shardings(pspecs, P()),
+            P(),
+        )
         param_sh = jax.tree_util.tree_map(
             lambda spec: NamedSharding(mesh, spec),
-            self.param_specs(p_aval),
+            pspecs,
             is_leaf=lambda x: isinstance(x, P),
         )
         self._state_sh = TrainState(
@@ -249,8 +260,32 @@ class TensorParallelEngine:
     def from_canonical(self, ts: TrainState) -> TrainState:
         """Place a canonical (host-complete) TrainState back into this
         engine's sharded runtime layout. All processes must pass the
-        same values (restore_checkpoint broadcasts host-0's read)."""
+        same values (restore_checkpoint broadcasts host-0's read).
+
+        This is also the RESHARD seam (`checkpointing/restore.py`): the
+        canonical form carries no mesh, so a checkpoint taken at one
+        factorization (S=4 FSDP, a 2×2 dcn×ici hybrid, ...) lands here
+        as full host arrays and this device_put re-slices them for the
+        CURRENT mesh — elastic resize needs no format conversion."""
         return jax.device_put(ts, self._state_sh)
+
+    def to_canonical_sharded(self, ts: TrainState) -> TrainState:
+        """Sharded-checkpoint seam (`checkpointing/save.py`): this
+        engine's runtime TrainState already has canonical TREE
+        structure — `to_canonical` only gathers values to host — so the
+        sharded save path persists the device-sharded leaves directly.
+        Each process then writes only its addressable chunks and the
+        per-leaf `process_allgather` of the legacy path is never
+        reached (pinned in tests/test_checkpoint_sharded.py). Engines
+        whose canonical form RESTRUCTURES state (pipeline stage-local
+        packing) deliberately do not define this method; the trainer
+        falls back with an actionable error."""
+        return ts
+
+    def state_partition_specs(self) -> TrainState:
+        """The PartitionSpec pytree of the runtime TrainState layout —
+        what a sharded checkpoint manifest records per leaf."""
+        return self._state_pspecs
 
     def shard_batch(self, inputs, labels):
         return _place_batch((inputs, labels), self._batch)

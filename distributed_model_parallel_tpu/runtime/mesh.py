@@ -162,6 +162,49 @@ def data_hierarchy_axes(mesh: Mesh):
     return d_axes, ici_axis, dcn_axis
 
 
+def mesh_axes(mesh: Mesh) -> dict:
+    """Ordered `{axis name: size}` — the factorization record a sharded
+    checkpoint manifest stores (`checkpointing/`), later handed back to
+    `elastic_fit`'s `make_trainer` so a restart can compare the saved
+    topology with the devices it actually has and rebuild RESIZED."""
+    return {name: int(mesh.shape[name]) for name in mesh.axis_names}
+
+
+def spec_from_axes(axes: dict) -> MeshSpec:
+    """Inverse of `mesh_axes` for the two axis spellings this module
+    builds: a plain ('data', ...) record maps straight onto MeshSpec
+    fields; a hybrid ('dcn', 'ici', ...) record folds back into
+    data=dcn*ici with the dcn factor preserved. Unknown axis names are
+    rejected — a manifest from a foreign mesh layout must not silently
+    drop a parallelism axis."""
+    known = set(AXES) | set(DATA_AXES_HYBRID)
+    unknown = set(axes) - known
+    if unknown:
+        raise ValueError(
+            f"unknown mesh axes {sorted(unknown)} in topology record "
+            f"(understood: {sorted(known)})"
+        )
+    if "dcn" in axes or "ici" in axes:
+        dcn = int(axes.get("dcn", 1))
+        data = dcn * int(axes.get("ici", 1))
+        if "data" in axes:
+            raise ValueError(
+                "topology record mixes 'data' with 'dcn'/'ici' — the "
+                "two spellings are exclusive"
+            )
+    else:
+        dcn = 1
+        data = int(axes.get("data", 1))
+    return MeshSpec(
+        data=data,
+        stage=int(axes.get("stage", 1)),
+        model=int(axes.get("model", 1)),
+        seq=int(axes.get("seq", 1)),
+        expert=int(axes.get("expert", 1)),
+        dcn=dcn,
+    )
+
+
 def local_mesh(**axes: int) -> Mesh:
     """Convenience: `local_mesh(stage=4)` on 8 devices → (2, 4, 1, 1) mesh
     (unspecified `data` absorbs the remaining devices)."""

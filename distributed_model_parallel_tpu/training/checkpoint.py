@@ -151,7 +151,8 @@ def restore_checkpoint(
     acc, epoch = 0.0, 0
     error: Optional[Exception] = None
     new_leaves = None
-    if jax.process_index() == 0 or os.path.isfile(npz_path):
+    primary = jax.process_index() == 0
+    if primary or os.path.isfile(npz_path):
         # Host 0 (or any host sharing the filesystem) reads the file. A
         # failure here must NOT raise before the broadcast below, or the
         # hosts on the zeros-placeholder path would block forever in
@@ -187,7 +188,16 @@ def restore_checkpoint(
                 acc = float(meta.get("acc", 0.0))
                 epoch = int(meta.get("epoch", 0))
         except Exception as e:  # noqa: BLE001 — re-raised after broadcast
-            error = e
+            # Only HOST 0's failure is authoritative. A truncated or
+            # garbage archive on a NON-ZERO host that happens to share
+            # the filesystem (its local read is an optimization, not
+            # the source of truth) must route through the same
+            # placeholder + agreement path as a host that cannot see
+            # the file at all — carrying its local error into the
+            # post-agreement raise would desynchronize it from the
+            # hosts that adopted host-0's read (and, under agreement
+            # schemes keyed on local state, deadlock host 0).
+            error = e if primary else None
             new_leaves = None  # may be partially filled; use placeholders
     if new_leaves is None:
         # Host without the file (per-host local disks) or a failed read:
@@ -224,21 +234,56 @@ def restore_checkpoint(
     return state, acc, epoch
 
 
+def newest_checkpoint_name(directory: str) -> str:
+    """Newer-by-recorded-epoch of the per-epoch 'last' and best-acc
+    'ckpt' snapshots, ties preferring 'last' (the one an elastic
+    restart writes every epoch). THE resume-preference rule — shared
+    by the Trainer's `--resume` and `cli/serve.py --checkpoint` so
+    training and serving can never pick different snapshots."""
+    last_ep = checkpoint_epoch(directory, "last")
+    ckpt_ep = checkpoint_epoch(directory, "ckpt")
+    if last_ep is not None and (ckpt_ep is None or last_ep >= ckpt_ep):
+        return "last"
+    return "ckpt"
+
+
+def _manifest_path(directory: str, name: str) -> str:
+    # Kept in sync with checkpointing/manifest.py (which imports FROM
+    # this module; reading the file name inline avoids the cycle).
+    return os.path.join(directory, f"{name}.manifest.json")
+
+
 def latest_exists(directory: str, name: str = "ckpt") -> bool:
-    return os.path.isfile(os.path.join(directory, f"{name}.npz"))
+    """True when a restorable checkpoint of either format is present:
+    the legacy single `.npz`, or a sharded-save manifest
+    (`checkpointing/` — the manifest is the sharded format's commit
+    point, so its existence means a complete save)."""
+    return os.path.isfile(
+        os.path.join(directory, f"{name}.npz")
+    ) or os.path.isfile(_manifest_path(directory, name))
 
 
 def checkpoint_epoch(directory: str, name: str = "ckpt") -> Optional[int]:
-    """Epoch recorded in `{name}.json`, or None when the checkpoint (or
-    its sidecar) is absent/corrupt — used to pick the NEWER of the
-    best-acc and per-epoch snapshots on resume, rather than trusting
-    file existence (a stale 'last' from an older run must not roll a
-    newer 'ckpt' back)."""
-    meta_path = os.path.join(directory, f"{name}.json")
-    if not latest_exists(directory, name) or not os.path.isfile(meta_path):
+    """Epoch recorded in `{name}.json` (legacy) or the sharded
+    manifest, or None when the checkpoint (or its sidecar) is
+    absent/corrupt — used to pick the NEWER of the best-acc and
+    per-epoch snapshots on resume, rather than trusting file existence
+    (a stale 'last' from an older run must not roll a newer 'ckpt'
+    back)."""
+    if not latest_exists(directory, name):
         return None
-    try:
-        with open(meta_path) as f:
-            return int(json.load(f).get("epoch", 0))
-    except (OSError, ValueError, json.JSONDecodeError):
-        return None
+    # Manifest first: the unified reader (`checkpointing/restore.py`)
+    # prefers a manifest when both formats share the directory, so the
+    # epoch answered here must describe the snapshot that would load.
+    for meta_path in (
+        _manifest_path(directory, name),
+        os.path.join(directory, f"{name}.json"),
+    ):
+        if not os.path.isfile(meta_path):
+            continue
+        try:
+            with open(meta_path) as f:
+                return int(json.load(f).get("epoch", 0))
+        except (OSError, ValueError, json.JSONDecodeError):
+            continue
+    return None

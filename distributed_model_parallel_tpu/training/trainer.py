@@ -35,10 +35,14 @@ from typing import Any, Iterable, Optional
 import jax
 import jax.numpy as jnp
 
+from distributed_model_parallel_tpu.checkpointing import (
+    AsyncCheckpointer,
+    restore_checkpoint,
+    save_sharded,
+)
 from distributed_model_parallel_tpu.runtime.dist import is_primary
 from distributed_model_parallel_tpu.training.checkpoint import (
-    checkpoint_epoch,
-    restore_checkpoint,
+    newest_checkpoint_name,
     save_checkpoint,
 )
 from distributed_model_parallel_tpu.training.multistep import (
@@ -97,6 +101,24 @@ class TrainerConfig:
     # from it; `--resume` prefers it over the best-acc snapshot when it
     # is newer.
     save_last: bool = False
+    # Checkpoint on-disk format: "legacy" = the reference-shaped single
+    # .npz gathered to host 0 (`training/checkpoint.py`); "sharded" =
+    # each process writes only its locally-addressable shards plus a
+    # JSON manifest (`checkpointing/` — ZeRO-style parallel save, no
+    # cross-process gather anywhere on the save path, and restore can
+    # RESHARD onto a different mesh). Restore auto-detects either
+    # format regardless of this setting.
+    checkpoint_format: str = "legacy"
+    # Move checkpoint file I/O off the step path (sharded format only):
+    # the save snapshots device->host once, then a background thread
+    # writes the files while training continues. Write errors are NEVER
+    # silent — they surface at the next save or at fit() exit
+    # (`checkpointing/writer.py`).
+    async_save: bool = False
+    # Extra JSON-able metadata stored in the checkpoint sidecar /
+    # manifest (e.g. the lm CLI records its GPTConfig so `cli/serve.py
+    # --checkpoint` can fail fast on a flag mismatch).
+    checkpoint_extra: Optional[dict] = None
     # Fold this many optimizer steps into ONE compiled dispatch
     # (lax.scan over stacked batches — `training/multistep.py`). The
     # training trajectory matches per-step dispatch to numerical
@@ -126,6 +148,20 @@ class Trainer:
         self.train_loader = train_loader
         self.val_loader = val_loader
         self.config = config
+        if config.checkpoint_format not in ("legacy", "sharded"):
+            raise ValueError(
+                "checkpoint_format must be 'legacy' or 'sharded', got "
+                f"{config.checkpoint_format!r}"
+            )
+        if config.async_save and config.checkpoint_format != "sharded":
+            raise ValueError(
+                "async_save moves the sharded writer off the step path; "
+                "it requires checkpoint_format='sharded' (the legacy "
+                "format gathers to host 0 synchronously by design)"
+            )
+        self._ckpt_writer = (
+            AsyncCheckpointer() if config.async_save else None
+        )
         self.lr_fn = cosine_warmup_schedule(
             config.base_lr, config.t_max, config.warmup_period
         )
@@ -141,11 +177,7 @@ class Trainer:
             # loses at most the failed epoch — but a stale 'last' from an
             # older run never rolls a newer 'ckpt' back. Only host 0's
             # files matter: restore_checkpoint broadcasts host-0's read.
-            name = "ckpt"
-            last_ep = checkpoint_epoch(config.checkpoint_dir, "last")
-            ckpt_ep = checkpoint_epoch(config.checkpoint_dir, "ckpt")
-            if last_ep is not None and (ckpt_ep is None or last_ep >= ckpt_ep):
-                name = "last"
+            name = newest_checkpoint_name(config.checkpoint_dir)
             restored, self.best_acc, last_epoch = restore_checkpoint(
                 config.checkpoint_dir, self._to_canonical(self.state),
                 name=name,
@@ -369,6 +401,27 @@ class Trainer:
     def fit(self) -> dict:
         """The 100-epoch driver loop (`data_parallel.py:160-172`): train,
         validate, checkpoint on best acc, append the epoch log line."""
+        try:
+            return self._fit()
+        except BaseException:
+            # The failure path (exactly where the elastic supervisor
+            # restarts from) must still DRAIN in-flight background
+            # writes: the restart reads this checkpoint directory
+            # immediately, and racing a half-committed save would hand
+            # it yesterday's (or no) manifest. A write failure here is
+            # printed, not raised — masking the training exception
+            # would hide the error the supervisor's retry_on keys on.
+            if self._ckpt_writer is not None:
+                try:
+                    self._ckpt_writer.wait()
+                except Exception as we:  # noqa: BLE001 — reported below
+                    self._log_print(
+                        "==> WARNING: background checkpoint write "
+                        f"failed during abort: {we!r}"
+                    )
+            raise
+
+    def _fit(self) -> dict:
         cfg = self.config
         for epoch in range(self.start_epoch, cfg.epochs):
             train_stats = self.train_epoch(epoch)
@@ -383,29 +436,23 @@ class Trainer:
                 and val_stats.acc1 > self.best_acc
             )
             if is_best or cfg.save_last:
-                canonical = self._to_canonical(self.state)  # once per epoch
+                payload = self._checkpoint_payload()  # once per epoch
             if is_best:
                 self.best_acc = val_stats.acc1
                 self._log_print("Saving..")
-                save_checkpoint(
-                    cfg.checkpoint_dir,
-                    canonical,
-                    acc=self.best_acc,
-                    epoch=epoch,
-                )
+                self._write_checkpoint(payload, "ckpt", epoch)
             if cfg.save_last:
                 # acc records the best-so-far (restored into best_acc on
                 # resume) — storing this epoch's val acc here would let a
                 # restart reset best_acc downward and a worse model later
                 # overwrite the best snapshot.
-                save_checkpoint(
-                    cfg.checkpoint_dir,
-                    canonical,
-                    acc=self.best_acc,
-                    epoch=epoch,
-                    name="last",
-                )
+                self._write_checkpoint(payload, "last", epoch)
             self._append_epoch_log(epoch, train_stats, val_stats)
+        if self._ckpt_writer is not None:
+            # fit() exit is the LAST surfacing point for async write
+            # errors (checkpointing/writer.py: never silent) and the
+            # join guaranteeing the final snapshot is durable on return.
+            self._ckpt_writer.wait()
         return {
             "best_acc": self.best_acc,
             "epochs": cfg.epochs,
@@ -413,6 +460,46 @@ class Trainer:
         }
 
     # ----------------------------------------------------------- helpers
+
+    def _checkpoint_payload(self):
+        """The tree handed to the checkpoint writer: the host-gathered
+        canonical form for the legacy format; for the sharded format,
+        the engine's DEVICE-SHARDED state via the `to_canonical_sharded`
+        seam (canonical tree structure, values still 1/N per process —
+        each process then persists only its addressable chunks and no
+        cross-process gather runs anywhere on the save path)."""
+        if self.config.checkpoint_format == "legacy":
+            return self._to_canonical(self.state)
+        fn = getattr(self.engine, "to_canonical_sharded", None)
+        if fn is not None:
+            return fn(self.state)
+        if getattr(self.engine, "to_canonical", None) is not None:
+            raise ValueError(
+                f"{type(self.engine).__name__} defines a RESTRUCTURING "
+                "canonical form (to_canonical) without a "
+                "to_canonical_sharded seam, so its runtime layout "
+                "cannot be written shard-for-shard; use "
+                "checkpoint_format='legacy' with this engine"
+            )
+        return self.state  # state IS canonical (DP/DDP/SP engines)
+
+    def _write_checkpoint(self, payload, name: str, epoch: int) -> None:
+        cfg = self.config
+        if cfg.checkpoint_format == "legacy":
+            save_checkpoint(
+                cfg.checkpoint_dir, payload, acc=self.best_acc,
+                epoch=epoch, name=name, extra=cfg.checkpoint_extra,
+            )
+            return
+        if self._ckpt_writer is not None:
+            # Surface an earlier epoch's failed background write BEFORE
+            # starting a new one (checkpointing/writer.py contract).
+            self._ckpt_writer.check()
+        save_sharded(
+            cfg.checkpoint_dir, payload, acc=self.best_acc,
+            epoch=epoch, name=name, extra=cfg.checkpoint_extra,
+            writer=self._ckpt_writer,
+        )
 
     def _to_canonical(self, state):
         """Checkpoints are written in the engine's layout-independent

@@ -307,6 +307,57 @@ def test_reducer_sweep_failure_rescues_partial_legs(
     assert out[0]["backend"] == "unreachable"
 
 
+def test_checkpoint_microbench_flag_is_wired():
+    """`--checkpoint-microbench` and its internal `--child-checkpoint`
+    parse (the parent spawns exactly that argv); mutual exclusion with
+    the other sweeps holds."""
+    import os
+    import subprocess
+    import sys
+
+    env = {**os.environ, "JAX_PLATFORMS": "cpu"}
+    res = subprocess.run(
+        [sys.executable, os.path.abspath(bench.__file__), "--help"],
+        capture_output=True, text=True, timeout=60, env=env,
+    )
+    assert res.returncode == 0
+    assert "--checkpoint-microbench" in res.stdout
+    assert "--child-checkpoint" in res.stdout
+    res = subprocess.run(
+        [sys.executable, os.path.abspath(bench.__file__),
+         "--serving-microbench", "--checkpoint-microbench"],
+        capture_output=True, text=True, timeout=60, env=env,
+    )
+    assert res.returncode != 0
+    assert "mutually exclusive" in res.stderr
+
+
+def test_checkpoint_sweep_failure_rescues_partial_legs(
+    monkeypatch, capsys
+):
+    """The checkpoint sweep rides the same per-leg rescue convention:
+    a row that streamed before a wedge survives into the final JSON."""
+    legs = [{"mode": "legacy_sync", "axis_size": 8,
+             "save_wall_ms": 50.0, "step_blocked_ms": 50.0,
+             "bytes_per_host": 1000}]
+
+    def fake_spawn(args, timeout_s, env=None, **kw):
+        out = "".join(
+            json.dumps({"leg": leg, "partial": True}) + "\n"
+            for leg in legs
+        )
+        return None, out, "child killed after timeout"
+
+    monkeypatch.setattr(bench, "_spawn", fake_spawn)
+    bench._run_sweep_child(
+        ["--child-checkpoint"], None, "checkpoint_microbench"
+    )
+    out = _parse_lines(capsys.readouterr().out)
+    assert len(out) == 1
+    assert out[0]["checkpoint_microbench"] == legs
+    assert out[0]["backend"] == "unreachable"
+
+
 def test_probe_flag_is_wired():
     """`bench.py --child-probe` parses (the parent spawns exactly this
     argv; a missing flag would make every probe attempt 'fail' and

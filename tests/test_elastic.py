@@ -2,6 +2,7 @@
 (SURVEY.md §5 failure-detection row; VERDICT r2 'what's weak' #8)."""
 
 import jax
+import numpy as np
 import pytest
 
 from distributed_model_parallel_tpu.data.datasets import synthetic
@@ -102,3 +103,178 @@ def test_elastic_gives_up_after_budget(tmp_path):
     with pytest.raises(RuntimeError, match="permanent failure"):
         elastic_fit(make_trainer, max_restarts=2, backoff_seconds=0.01)
     assert len(trainers) == 3  # initial + 2 restarts, then fail-fast
+
+
+# ------------------------------------------- backoff schedule + summary
+
+
+def test_backoff_schedule_exponential_with_cap():
+    from distributed_model_parallel_tpu.training.elastic import (
+        backoff_schedule,
+    )
+
+    assert [
+        backoff_schedule(k, 1.0, 60.0) for k in (1, 2, 3, 4)
+    ] == [1.0, 2.0, 4.0, 8.0]
+    # The cap clamps, never amplifies.
+    assert backoff_schedule(10, 1.0, 60.0) == 60.0
+    assert backoff_schedule(1, 5.0, 2.0) == 2.0
+    with pytest.raises(ValueError):
+        backoff_schedule(0, 1.0, 60.0)
+
+
+class _DiesNTimes:
+    """Trainer stand-in: fit() raises `exc` the first `n` calls, then
+    returns a minimal summary — no engine/mesh needed to test the
+    supervisor's schedule."""
+
+    def __init__(self, n, exc=RuntimeError):
+        self.n = n
+        self.exc = exc
+
+    def fit(self):
+        if self.n > 0:
+            self.n -= 1
+            raise self.exc(f"boom ({self.n} left)")
+        return {"best_acc": 0.0, "history": []}
+
+
+def test_elastic_backoff_sleeps_and_summary(monkeypatch):
+    from distributed_model_parallel_tpu.training import elastic
+
+    sleeps = []
+    monkeypatch.setattr(elastic.time, "sleep", sleeps.append)
+    box = _DiesNTimes(3, ValueError)
+    result = elastic.elastic_fit(
+        lambda resume: box,
+        max_restarts=3,
+        backoff_seconds=0.5,
+        max_backoff_seconds=1.5,
+        jitter=lambda attempt: 0.01 * attempt,
+    )
+    # Exponential 0.5, 1.0, then capped at 1.5 — plus the jitter hook.
+    assert sleeps == pytest.approx([0.51, 1.02, 1.53])
+    el = result["elastic"]
+    assert el["attempts"] == 4
+    assert [r["error_type"] for r in el["restarts"]] == ["ValueError"] * 3
+    assert [r["attempt"] for r in el["restarts"]] == [1, 2, 3]
+    assert [r["backoff_s"] for r in el["restarts"]] == pytest.approx(
+        [0.51, 1.02, 1.53]
+    )
+
+
+def test_elastic_retry_on_narrowing(monkeypatch):
+    """retry_on=(TypeError,) must NOT absorb a ValueError — it
+    propagates immediately, zero restarts."""
+    from distributed_model_parallel_tpu.training import elastic
+
+    sleeps = []
+    monkeypatch.setattr(elastic.time, "sleep", sleeps.append)
+    calls = []
+
+    def make_trainer(resume):
+        calls.append(resume)
+        return _DiesNTimes(5, ValueError)
+
+    with pytest.raises(ValueError, match="boom"):
+        elastic.elastic_fit(
+            make_trainer, max_restarts=3, retry_on=(TypeError,),
+        )
+    assert calls == [False] and sleeps == []
+    # ... while a matching type does retry.
+    calls.clear()
+    box = _DiesNTimes(1, TypeError)
+    result = elastic.elastic_fit(
+        lambda resume: (calls.append(resume), box)[1],
+        max_restarts=3, retry_on=(TypeError,), backoff_seconds=0.0,
+    )
+    assert calls == [False, True]
+    assert result["elastic"]["restarts"][0]["error_type"] == "TypeError"
+
+
+# ----------------------------------------------------- elastic resize
+
+
+def test_elastic_resize_restores_sharded_checkpoint_onto_bigger_mesh(
+    tmp_path,
+):
+    """Genuine elasticity: an S=4 FSDP run dies after its first epoch's
+    sharded save; the restart's `make_trainer(resume, topology)`
+    receives the manifest's saved topology (data=4) and rebuilds onto
+    the FULL 8-device mesh — the resharding restore places the state
+    bit-exact (acceptance: S=4 -> S=8 through elastic_fit's resize
+    path)."""
+    from distributed_model_parallel_tpu.checkpointing import (
+        restore_checkpoint,
+    )
+    from distributed_model_parallel_tpu.parallel.fsdp import FSDPEngine
+
+    ds = synthetic(num_examples=128, num_classes=4, image_size=8, seed=0)
+    ckdir = str(tmp_path / "ckpt")
+    devs = jax.devices()
+    topologies = []
+    trainers = []
+    restored_canonicals = []
+
+    def build_engine(n_data):
+        mesh = make_mesh(MeshSpec(data=n_data), devices=devs[:n_data])
+        inner = FSDPEngine(
+            tiny_cnn(4), SGD(), mesh, donate=False, min_shard_elems=64
+        )
+        return inner
+
+    def make_trainer(restart, topology):
+        topologies.append(topology)
+        if not restart:
+            engine = FlakyEngine(
+                build_engine(4), fail_at_call=7,  # dies in epoch 1
+            )
+        else:
+            # The preempted slice came back bigger: resize to all 8
+            # devices; the restore reshards the S=4 state to fit.
+            assert topology is not None
+            assert topology["mesh_axes"]["data"] == 4
+            engine = build_engine(8)
+        cfg = TrainerConfig(
+            epochs=3, base_lr=0.05, t_max=3, warmup_period=1,
+            print_freq=0,
+            log_dir=str(tmp_path / "log"),
+            checkpoint_dir=ckdir,
+            resume=restart and latest_exists(ckdir, "last"),
+            save_last=True,
+            checkpoint_format="sharded",
+        )
+        train = Loader(ds, batch_size=32, shuffle=True, seed=0)
+        val = Loader(ds, batch_size=32, shuffle=False)
+        t = Trainer(engine, train, val, cfg, rng=jax.random.PRNGKey(0))
+        trainers.append(t)
+        if restart:
+            # Bit-exact reshard through the elastic path, checked at
+            # restart time (before this trainer overwrites 'last' with
+            # later epochs): what the S=8 trainer starts from equals
+            # the S=4 checkpoint on disk, reassembled independently.
+            started_from = jax.tree_util.tree_map(
+                lambda x: np.asarray(x),
+                jax.device_get(t._to_canonical(t.state)),
+            )
+            expected, _, _ = restore_checkpoint(
+                ckdir, started_from, name="last"
+            )
+            for a, b in zip(
+                jax.tree_util.tree_leaves(expected),
+                jax.tree_util.tree_leaves(started_from),
+            ):
+                np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+            restored_canonicals.append(started_from)
+        return t
+
+    result = elastic_fit(
+        make_trainer, max_restarts=2, backoff_seconds=0.01,
+        checkpoint_dir=ckdir,
+    )
+    assert len(trainers) == 2
+    assert topologies[0] is None  # first attempt: nothing saved yet
+    assert trainers[1].start_epoch == 1  # lost at most the failed epoch
+    assert {h["epoch"] for h in result["history"]} == {1, 2}
+    assert result["elastic"]["restarts"][0]["error_type"] == "RuntimeError"
+    assert restored_canonicals, "restart never verified the reshard"

@@ -328,3 +328,139 @@ def test_resume_continues_from_epoch(engine, tmp_path):
     assert t2.best_acc == pytest.approx(t1.best_acc)
     result = t2.fit()
     assert result["best_acc"] >= t1.best_acc
+
+
+# ------------------------------------------ sharded / async checkpoints
+
+
+def test_trainer_sharded_format_saves_and_resumes(engine, tmp_path):
+    """checkpoint_format='sharded' writes manifests instead of .npz and
+    resume restores through the unified reader (checkpointing/)."""
+    from distributed_model_parallel_tpu.checkpointing import (
+        manifest_exists,
+    )
+
+    train, val = loaders(n=128)
+    common = dict(
+        base_lr=0.05, t_max=3, warmup_period=1, print_freq=0,
+        log_dir=str(tmp_path / "log"),
+        checkpoint_dir=str(tmp_path / "ckpt"),
+        checkpoint_format="sharded",
+        save_last=True,
+    )
+    t1 = Trainer(engine, train, val, TrainerConfig(epochs=2, **common),
+                 rng=jax.random.PRNGKey(0))
+    t1.fit()
+    assert manifest_exists(str(tmp_path / "ckpt"), "last")
+    assert not os.path.isfile(tmp_path / "ckpt" / "last.npz")
+    final = jax.tree_util.tree_map(
+        lambda x: np.asarray(x), jax.device_get(t1.state)
+    )
+
+    mesh = make_mesh(MeshSpec(data=8))
+    engine2 = DataParallelEngine(
+        model=tiny_model(), optimizer=SGD(), mesh=mesh
+    )
+    t2 = Trainer(engine2, train, val,
+                 TrainerConfig(epochs=4, resume=True, **common),
+                 rng=jax.random.PRNGKey(9))
+    assert t2.start_epoch == 2
+    assert t2.best_acc == pytest.approx(t1.best_acc)
+    for a, b in zip(jax.tree_util.tree_leaves(final),
+                    jax.tree_util.tree_leaves(jax.device_get(t2.state))):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_trainer_async_write_error_surfaces_at_fit_exit(
+    engine, tmp_path, monkeypatch
+):
+    """A failed background write must fail fit(), not vanish."""
+    from distributed_model_parallel_tpu.checkpointing import (
+        writer as writer_mod,
+    )
+
+    def crashing(path, arrays):
+        raise OSError("no space left on device")
+
+    monkeypatch.setattr(writer_mod, "_write_shard", crashing)
+    train, val = loaders(n=64)
+    cfg = TrainerConfig(
+        epochs=1, base_lr=0.05, t_max=1, warmup_period=1, print_freq=0,
+        log_dir=str(tmp_path / "log"),
+        checkpoint_dir=str(tmp_path / "ckpt"),
+        checkpoint_format="sharded", async_save=True,
+        save_best=False, save_last=True,
+    )
+    t = Trainer(engine, train, val, cfg, rng=jax.random.PRNGKey(0))
+    with pytest.raises(OSError, match="no space left"):
+        t.fit()
+
+
+def test_trainer_async_requires_sharded_format(engine, tmp_path):
+    train, val = loaders(n=64)
+    cfg = TrainerConfig(
+        epochs=1, print_freq=0, checkpoint_dir=str(tmp_path / "ckpt"),
+        checkpoint_format="legacy", async_save=True,
+    )
+    with pytest.raises(ValueError, match="async_save"):
+        Trainer(engine, train, val, cfg, rng=jax.random.PRNGKey(0))
+    with pytest.raises(ValueError, match="checkpoint_format"):
+        Trainer(engine, train, val,
+                TrainerConfig(checkpoint_format="zip"),
+                rng=jax.random.PRNGKey(0))
+
+
+def test_trainer_async_drains_writes_when_fit_aborts(
+    engine, tmp_path, monkeypatch
+):
+    """fit() dying mid-epoch (the elastic restart path) must DRAIN
+    in-flight background writes before the exception propagates — the
+    supervisor reads the checkpoint directory immediately after, and a
+    half-committed save would hand it yesterday's (or no) manifest."""
+    import time as _time
+
+    from distributed_model_parallel_tpu.checkpointing import (
+        manifest_exists,
+        writer as writer_mod,
+    )
+    from distributed_model_parallel_tpu.training.checkpoint import (
+        checkpoint_epoch,
+    )
+
+    real = writer_mod._write_shard
+
+    def slow(path, arrays):
+        _time.sleep(0.4)  # force the abort to race the write
+        real(path, arrays)
+
+    monkeypatch.setattr(writer_mod, "_write_shard", slow)
+
+    class DiesInEpoch1:
+        def __init__(self, inner):
+            self.inner = inner
+            self.calls = 0
+
+        def __getattr__(self, name):
+            return getattr(self.inner, name)
+
+        def train_step(self, *args):
+            self.calls += 1
+            if self.calls == 5:  # 4 steps/epoch: dies in epoch 1
+                raise RuntimeError("preempted")
+            return self.inner.train_step(*args)
+
+    train, val = loaders(n=128)
+    cfg = TrainerConfig(
+        epochs=3, base_lr=0.05, t_max=3, warmup_period=1, print_freq=0,
+        log_dir=str(tmp_path / "log"),
+        checkpoint_dir=str(tmp_path / "ckpt"),
+        checkpoint_format="sharded", async_save=True,
+        save_best=False, save_last=True,
+    )
+    t = Trainer(DiesInEpoch1(engine), train, val, cfg,
+                rng=jax.random.PRNGKey(0))
+    with pytest.raises(RuntimeError, match="preempted"):
+        t.fit()
+    # Epoch 0's save is fully committed despite the slow writer.
+    assert manifest_exists(str(tmp_path / "ckpt"), "last")
+    assert checkpoint_epoch(str(tmp_path / "ckpt"), "last") == 0
