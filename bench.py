@@ -921,6 +921,169 @@ def run_child_reducer(max_devices: int, platform: str = "cpu") -> None:
     print(json.dumps(out, indent=2))
 
 
+def run_child_moe(max_devices: int, platform: str = "cpu") -> None:
+    """Flat-vs-hierarchical-vs-overlapped MoE dispatch microbench
+    (`ops/expert_dispatch.py`) — the expert-exchange counterpart of the
+    reducer table.
+
+    For each expert-parallel size S, times one MoE layer's
+    exchange + expert FFN + return over a fixed (E, B/S, C, D) dispatch
+    buffer in three lowerings:
+      * flat         — ONE fused `lax.all_to_all` over the joint
+                       fabric each way (the shape the GSPMD partitioner
+                       picks; on a hybrid mesh the full payload crosses
+                       'dcn' in (K-1)*I fragments);
+      * hierarchical — the explicit two-level exchange on a 2 x (S/2)
+                       dcn x ici mesh: intra-slice all-to-all over
+                       'ici', ONE cross-slice exchange on the
+                       1/ici-regrouped shard, all moe_ring ppermutes;
+      * overlapped   — the same hops fused with the FFN: chunk k's
+                       expert compute runs while chunk k+1's permute
+                       (and chunk k's return) are in flight.
+
+    Emits one partial JSON line per completed size (a wedge mid-sweep
+    keeps the finished legs), then the table. Meaningful on a real
+    slice; on virtual CPU devices the rings serialize onto one core
+    (the note in the JSON says so)."""
+    if max_devices < 2:
+        raise ValueError(f"--max-devices must be >= 2, got {max_devices}")
+    if platform == "cpu":
+        from distributed_model_parallel_tpu.runtime.platform import force_cpu
+
+        force_cpu(max_devices)
+
+    from functools import partial
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.sharding import Mesh, PartitionSpec as P
+
+    from distributed_model_parallel_tpu.models.moe import expert_ffn
+    from distributed_model_parallel_tpu.ops.expert_dispatch import (
+        exchanged_expert_ffn,
+        flat_expert_exchange,
+        flat_expert_return,
+    )
+    from distributed_model_parallel_tpu.runtime.compat import shard_map
+
+    devices = jax.devices("cpu") if platform == "cpu" else jax.devices()
+    sizes = []
+    n = 2
+    while n <= min(max_devices, len(devices)):
+        sizes.append(n)
+        n *= 2
+
+    # One MoE layer's worth of dispatch buffers: E experts, a per-shard
+    # token load, capacity rows, model dim — a few MB, enough that the
+    # exchange dominates on a real fabric without drowning the CPU
+    # harness.
+    E, BL, C, D, H = 16, 4, 8, 64, 128
+    rng = np.random.RandomState(0)
+    xin = jnp.asarray(rng.randn(E, BL * max(sizes), C, D), jnp.float32)
+    w = {
+        "w_in": jnp.asarray(0.02 * rng.randn(E, D, H), jnp.float32),
+        "b_in": jnp.zeros((E, H), jnp.float32),
+        "w_out": jnp.asarray(0.02 * rng.randn(E, H, D), jnp.float32),
+        "b_out": jnp.zeros((E, D), jnp.float32),
+    }
+    payload_mb = xin.size * 4 / 1e6
+
+    def fence(out):
+        _ = jax.device_get(out.ravel()[0])
+
+    def time_fn(fn, iters=10):
+        fence(fn(xin, w))  # compile + warmup
+        t0 = time.perf_counter()
+        for _i in range(iters):
+            out = fn(xin, w)
+        fence(out)
+        return (time.perf_counter() - t0) / iters * 1e3
+
+    def build(mesh, names, body):
+        dd = tuple(names)
+        wspec = {
+            k: P(dd, *([None] * (v.ndim - 1))) for k, v in w.items()
+        }
+        return jax.jit(shard_map(
+            body, mesh=mesh,
+            in_specs=(P(None, dd, None, None), wspec),
+            out_specs=P(None, dd, None, None), check_vma=False,
+        ))
+
+    def flat_body(xl, wl, *, dd):
+        z = flat_expert_exchange(xl, dd)
+        y = expert_ffn(wl, z)
+        return flat_expert_return(y, dd)
+
+    rows = []
+    for size in sizes:
+        flat_mesh = Mesh(np.array(devices[:size]), ("data",))
+        flat = build(
+            flat_mesh, ("data",), partial(flat_body, dd=("data",))
+        )
+        hier_mesh = Mesh(
+            np.array(devices[:size]).reshape(2, size // 2),
+            ("dcn", "ici"),
+        )
+
+        def hier_body(xl, wl, overlap):
+            return exchanged_expert_ffn(
+                xl, partial(expert_ffn, wl), "ici", "dcn", overlap
+            )
+
+        hierarchical = build(
+            hier_mesh, ("dcn", "ici"),
+            partial(hier_body, overlap=False),
+        )
+        overlapped = build(
+            hier_mesh, ("dcn", "ici"),
+            partial(hier_body, overlap=True),
+        )
+        row = {
+            "axis_size": size,
+            "flat_ms": round(time_fn(flat), 3),
+            "hierarchical_ms": round(time_fn(hierarchical), 3),
+            "overlapped_ms": round(time_fn(overlapped), 3),
+        }
+        row["hierarchical_speedup"] = round(
+            row["flat_ms"] / max(row["hierarchical_ms"], 1e-9), 3
+        )
+        row["overlapped_speedup"] = round(
+            row["flat_ms"] / max(row["overlapped_ms"], 1e-9), 3
+        )
+        rows.append(row)
+        log(f"S={size}: flat {row['flat_ms']}ms, hierarchical "
+            f"{row['hierarchical_ms']}ms, overlapped "
+            f"{row['overlapped_ms']}ms")
+        # Per-leg partial line (same convention as the other sweeps).
+        print(json.dumps({"leg": row, "partial": True}), flush=True)
+
+    out = {
+        "moe_microbench": rows,
+        "platform": jax.devices()[0].platform,
+        "device_kind": jax.devices()[0].device_kind,
+        "experts": E,
+        "dispatch_payload_mb": round(payload_mb, 2),
+        "hierarchy": "2 x S/2 (dcn x ici)",
+        "workload": (
+            f"one MoE layer's exchange+FFN+return over an "
+            f"(E={E}, B, C={C}, D={D}) dispatch buffer, FFN hidden "
+            f"{H}; flat = fused lax.all_to_all both ways, "
+            "hierarchical/overlapped = the moe_ring two-level path"
+        ),
+    }
+    if jax.devices()[0].platform == "cpu":
+        out["note"] = (
+            "virtual CPU devices serialize the rings onto one core, so "
+            "chunk overlap cannot win here; the harness is meaningful "
+            "on a real slice, where the cross-slice hops carry the "
+            "1/ici-regrouped shard in K-1 contiguous messages and the "
+            "per-chunk FFN hides them"
+        )
+    print(json.dumps(out, indent=2))
+
+
 def run_child_serving(max_devices: int, platform: str = "cpu") -> None:
     """Serving microbench (`serving/engine.py`) — tokens/sec and
     p50/p99 per-token latency, prefill vs decode legs, per cache
@@ -1243,11 +1406,19 @@ def _cpu_child_env(n_devices: int = 8) -> dict:
 
 def _kill_group(child) -> None:
     """Kill a child's whole process group (children are spawned with
-    start_new_session=True, so pgid == pid)."""
+    start_new_session=True, so pgid == pid) and REAP the direct child:
+    without the wait, a caller checking `child.poll()` right after the
+    SIGKILL races the kernel's exit transition (observed as a flaky
+    still-None poll on fast hosts) and the zombie lingers until
+    interpreter exit."""
     if child is not None and child.poll() is None:
         try:
             os.killpg(child.pid, signal.SIGKILL)
         except (ProcessLookupError, PermissionError):
+            pass
+        try:
+            child.wait(timeout=5)
+        except Exception:  # noqa: BLE001 — best-effort reap
             pass
 
 
@@ -1598,6 +1769,13 @@ if __name__ == "__main__":
              "line; devices from --scaling-platform / --max-devices",
     )
     parser.add_argument(
+        "--moe-microbench", action="store_true",
+        help="print a flat-vs-hierarchical-vs-overlapped MoE expert-"
+             "dispatch table (two-level dcn×ici moe_ring exchange, "
+             "ops/expert_dispatch.py) instead of the single benchmark "
+             "line; devices from --scaling-platform / --max-devices",
+    )
+    parser.add_argument(
         "--serving-microbench", action="store_true",
         help="print a per-layout serving table (tokens/sec + p50/p99 "
              "per-token latency, prefill vs decode legs, over the "
@@ -1629,6 +1807,9 @@ if __name__ == "__main__":
     parser.add_argument("--child-reducer", action="store_true",
                         help="internal: run the gradient-reduction "
                              "microbench in-process")
+    parser.add_argument("--child-moe", action="store_true",
+                        help="internal: run the MoE dispatch "
+                             "microbench in-process")
     parser.add_argument("--child-serving", action="store_true",
                         help="internal: run the serving microbench "
                              "in-process")
@@ -1644,14 +1825,16 @@ if __name__ == "__main__":
 
     n_sweeps = sum(
         (args.scaling, args.cm_microbench, args.reducer_microbench,
-         args.serving_microbench, args.checkpoint_microbench)
+         args.moe_microbench, args.serving_microbench,
+         args.checkpoint_microbench)
     )
     if n_sweeps > 1:
         parser.error(
             "--scaling / --cm-microbench / --reducer-microbench / "
-            "--serving-microbench / --checkpoint-microbench are "
-            "mutually exclusive (one sweep per invocation; running "
-            "several would silently drop tables)"
+            "--moe-microbench / --serving-microbench / "
+            "--checkpoint-microbench are mutually exclusive (one sweep "
+            "per invocation; running several would silently drop "
+            "tables)"
         )
 
     if args.child_probe:
@@ -1670,6 +1853,9 @@ if __name__ == "__main__":
         sys.exit(0)
     if args.child_reducer:
         run_child_reducer(args.max_devices, args.scaling_platform)
+        sys.exit(0)
+    if args.child_moe:
+        run_child_moe(args.max_devices, args.scaling_platform)
         sys.exit(0)
     if args.child_serving:
         run_child_serving(args.max_devices, args.scaling_platform)
@@ -1716,6 +1902,13 @@ if __name__ == "__main__":
                      "--max-devices", str(args.max_devices),
                      "--scaling-platform", args.scaling_platform],
                     env, "reducer_microbench",
+                )
+            elif args.moe_microbench:
+                _run_sweep_child(
+                    ["--child-moe",
+                     "--max-devices", str(args.max_devices),
+                     "--scaling-platform", args.scaling_platform],
+                    env, "moe_microbench",
                 )
             elif args.serving_microbench:
                 _run_sweep_child(

@@ -49,6 +49,12 @@ class Combo:
     collective_matmul: bool = False
     bf16: bool = False
     model: str = "mlp"  # mlp | tinycnn (ddp/fsdp families)
+    # MoE dispatch mode (engine == "ep"): "gspmd" = partitioner-chosen
+    # flat exchange over 'expert'; "hierarchical" = the explicit
+    # two-level moe_ring exchange over the (factored) data fabric,
+    # "+ov" chunk-overlapped (`ops/expert_dispatch.py`).
+    moe_dispatch: str = "gspmd"
+    moe_overlap: bool = False
 
     @property
     def name(self) -> str:
@@ -57,6 +63,10 @@ class Combo:
             bits.append(f"dcn{self.dcn}")
         if self.engine in ("ddp", "fsdp", "sp_lm"):
             bits.append(self.grad_reduction)
+        if self.engine == "ep":
+            bits.append(self.moe_dispatch)
+            if self.moe_overlap:
+                bits.append("ov")
         if self.model != "mlp":
             bits.append(self.model)
         if self.collective_matmul:
@@ -100,6 +110,42 @@ def staged_mlp(n_blocks=8, width=32, classes=4):
         for _ in range(n_blocks)
     ]
     return staging.staged_model(stem, blocks, L.linear(width, classes))
+
+
+def moe_classifier(num_experts: int, dim: int = 16, seq: int = 8,
+                   num_classes: int = 4, top_k: int = 2,
+                   capacity_factor: float = 1.25):
+    """Tiny one-block MoE classifier (tokens (B, T, D) -> logits) —
+    ONE routed layer so the moe_ring permute pin is exact. Public and
+    imported by tests/test_expert_dispatch.py, so the lint matrix and
+    the parity tests lower the SAME model (the staged_mlp/image_batch
+    no-desync convention)."""
+    import jax
+
+    from distributed_model_parallel_tpu.models import layers as L
+    from distributed_model_parallel_tpu.models.moe import (
+        moe_encoder_layer,
+    )
+
+    block = moe_encoder_layer(
+        dim, 2, 2 * dim, num_experts, top_k=top_k,
+        capacity_factor=capacity_factor, dropout_rate=0.0,
+    )
+    head = L.linear(dim, num_classes)
+
+    def init(key):
+        kb, kh = jax.random.split(key)
+        bp, bs = block.init(kb)
+        return {"block": bp, "head": head.init(kh)[0]}, {"block": bs}
+
+    def apply(params, state, x, ctx):
+        (h, _), bs = block.apply(
+            params["block"], state.get("block", {}), (x, None), ctx
+        )
+        logits, _ = head.apply(params["head"], {}, h.mean(axis=1), ctx)
+        return logits, {"block": bs}
+
+    return L.Layer(init, apply)
 
 
 def _bert_cfg(model_size: int):
@@ -535,6 +581,72 @@ def _build_sp_lm(combo: Combo, devices):
     return target, hlo, mesh
 
 
+def _build_ep(combo: Combo, devices):
+    """MoE expert-parallel train steps (`parallel/expert_parallel.py`).
+    `moe_dispatch="gspmd"`: the original 'expert'-axis layout on a
+    (data=2, expert=S) mesh, judged by the generic rules only.
+    `moe_dispatch="hierarchical"` (+overlap): the explicit two-level
+    exchange over a (data=S[, dcn]) fabric — rule `moe-hierarchical-
+    a2a` pins the exact moe_ring chain and the absence of any flat
+    all-to-all on the data axes."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from distributed_model_parallel_tpu.ops.expert_dispatch import (
+        exchange_permutes,
+    )
+    from distributed_model_parallel_tpu.parallel.expert_parallel import (
+        ExpertParallelEngine,
+    )
+    from distributed_model_parallel_tpu.runtime.mesh import (
+        MeshSpec, make_mesh,
+    )
+    from distributed_model_parallel_tpu.training.optim import SGD
+
+    s = combo.size
+    dim, seq = 16, 8
+    if combo.moe_dispatch == "hierarchical":
+        mesh = make_mesh(
+            MeshSpec(data=s, dcn=combo.dcn), devices=devices[:s]
+        )
+        eng = ExpertParallelEngine(
+            moe_classifier(s, dim=dim), SGD(), mesh, donate=True,
+            dispatch="hierarchical", overlap=combo.moe_overlap,
+        )
+        facts = _mesh_facts(mesh)
+        # One MoE layer, fwd exchange pair + mirrored backward.
+        expected = 2 * exchange_permutes(
+            facts["ici_size"], facts["dcn_size"]
+        )
+    else:
+        dp = 2 if 2 * s <= len(devices) else 1
+        mesh = make_mesh(
+            MeshSpec(data=dp, expert=s), devices=devices[: dp * s]
+        )
+        eng = ExpertParallelEngine(
+            moe_classifier(s, dim=dim), SGD(), mesh, donate=True
+        )
+        facts = _mesh_facts(mesh)
+        expected = None
+    ts = eng.init_state(jax.random.PRNGKey(0))
+    rng = np.random.RandomState(0)
+    n = max(8, int(mesh.shape[facts["ici_axis"]]) * facts["dcn_size"])
+    x = rng.randn(n, seq, dim).astype(np.float32)
+    lb = rng.randint(0, 4, size=(n,)).astype(np.int32)
+    xs, lbs = eng.shard_batch(x, lb)
+    hlo = eng.train_step.lower(
+        ts, xs, lbs, jnp.float32(0.1)
+    ).compile().as_text()
+    target = LintTarget(
+        name=combo.name, engine="ep", donate=True,
+        moe_dispatch=combo.moe_dispatch,
+        moe_ring_permutes=expected,
+        n_param_leaves=_n_param_leaves(ts), **facts,
+    )
+    return target, hlo, mesh
+
+
 def _build_pipeline(combo: Combo, devices):
     import jax
     import jax.numpy as jnp
@@ -683,6 +795,7 @@ _BUILDERS: dict = {
     "cm_ag": _build_cm_op,
     "cm_rs": _build_cm_op,
     "serve": _build_serve,
+    "ep": _build_ep,
 }
 
 
@@ -737,6 +850,18 @@ def full_matrix() -> List[Combo]:
     combos += [Combo("pipeline", 2), Combo("pipeline", 4)]
     combos.append(Combo("tp", 4, collective_matmul=True, bf16=True))
     combos.append(Combo("sp", 4, collective_matmul=True, bf16=True))
+    # MoE dispatch (PR 10): the GSPMD 'expert'-axis baseline plus the
+    # hierarchical exchange at S in {4, 8}, overlapped, and on a
+    # 2 x (S/2) hybrid fabric — rule moe-hierarchical-a2a's pins.
+    combos.append(Combo("ep", 4))  # gspmd baseline
+    combos.append(Combo("ep", 4, moe_dispatch="hierarchical"))
+    combos.append(
+        Combo("ep", 4, moe_dispatch="hierarchical", moe_overlap=True)
+    )
+    combos.append(
+        Combo("ep", 8, dcn=2, moe_dispatch="hierarchical",
+              moe_overlap=True)
+    )
     combos += pregate_matrix()
     return combos
 
@@ -744,10 +869,14 @@ def full_matrix() -> List[Combo]:
 def pregate_matrix() -> List[Combo]:
     """The tier-1 pre-gate subset (tools/tier1.sh): tinycnn DDP + FSDP
     overlapped — the deepest rule stack (rings + overlap deps + BN
-    allowlist + at-rest) for two lowerings' worth of compile time."""
+    allowlist + at-rest) — plus one tinycnn-sized hierarchical MoE
+    combo on a hybrid fabric, so a dispatch regression fails in seconds
+    with `moe-hierarchical-a2a` named."""
     return [
         Combo("ddp", 8, grad_reduction="overlapped", model="tinycnn"),
         Combo("fsdp", 8, grad_reduction="overlapped", model="tinycnn"),
+        Combo("ep", 4, dcn=2, moe_dispatch="hierarchical",
+              moe_overlap=True),
     ]
 
 

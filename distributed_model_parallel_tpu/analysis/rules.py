@@ -105,6 +105,14 @@ class LintTarget:
     # Non-scalar all-reduce allowlist: BN state / batch-stat shapes.
     state_leaf_shapes: Tuple[Tuple[int, ...], ...] = ()
 
+    # MoE dispatch expectations (engine == "ep"): which exchange the
+    # combo opted into, and — for "hierarchical" — the EXACT count of
+    # `moe_ring`-scoped collective-permutes one train step must carry
+    # (2 x exchange_permutes(ici, dcn) per MoE layer: forward pair +
+    # its mirrored backward; `ops/expert_dispatch.py`).
+    moe_dispatch: str = "gspmd"
+    moe_ring_permutes: Optional[int] = None
+
     # rule_id -> reason; the finding is reported but not counted
     # (module docstring).
     exemptions: Dict[str, str] = dataclasses.field(default_factory=dict)
@@ -631,6 +639,61 @@ def _bf16_ring_upcast(ctx: LintContext) -> List[Finding]:
                 f"f32 ppermute over '{t.cm_axis}' in the traced step "
                 f"(scope {scope!r}) — silent upcast on an opted-in "
                 "bf16 ring",
+            ))
+    return out
+
+
+@rule(
+    id="moe-hierarchical-a2a", severity="error", source="PR 10",
+    contract=(
+        "An opted-in hierarchical MoE step keeps the token exchange on "
+        "the explicit two-level path: ZERO token-sized all-to-all "
+        "touching the data fabric (the flat exchange the partitioner "
+        "would insert — on a hybrid mesh it would drag the full "
+        "payload across 'dcn'), and EXACTLY the expected moe_ring-"
+        "scoped collective-permute chain (2(I-1)+2(K-1) per exchange "
+        "pair, doubled by the mirrored backward — "
+        "ops/expert_dispatch.exchange_permutes)."
+    ),
+    applies=lambda t: t.engine == "ep"
+    and t.moe_dispatch == "hierarchical",
+)
+def _moe_hierarchical_a2a(ctx: LintContext) -> List[Finding]:
+    import re as _re
+
+    t = ctx.target
+    if t.moe_ring_permutes is None:
+        return [ctx.finding(
+            "moe-hierarchical-a2a",
+            "no moe_ring_permutes expectation on an opted-in MoE combo "
+            "— the exchange chain was not checked",
+        )]
+    out = []
+    # Word-matched, not tagged(): the backward hops surface as
+    # `transpose(moe_ring)` in op_name, which the trailing-slash form
+    # would miss; \b keeps a future moe_ring2 scope from inheriting.
+    tagged = [
+        i for i in ctx.module.collectives()
+        if i.base_op == "collective-permute"
+        and _re.search(r"\bmoe_ring\b", i.op_name)
+    ]
+    if len(tagged) != t.moe_ring_permutes:
+        out.append(ctx.finding(
+            "moe-hierarchical-a2a",
+            f"{len(tagged)} moe_ring-scoped permutes, expected exactly "
+            f"{t.moe_ring_permutes} (2(I-1)+2(K-1) per exchange pair, "
+            "forward + mirrored backward)",
+        ))
+    for c in ctx.collectives:
+        if c.kind == "all-to-all" and any(
+            c.crosses(a) for a in t.data_axes
+        ):
+            out.append(ctx.finding(
+                "moe-hierarchical-a2a",
+                f"{c.name}: {c.payload_bytes} B all-to-all touching the "
+                f"data fabric {tuple(t.data_axes)} — the flat token "
+                "exchange survived on an opted-in step",
+                c.name,
             ))
     return out
 

@@ -19,6 +19,8 @@ interpretable.
       --epochs 5 --lr 3e-4
   python -m distributed_model_parallel_tpu.cli.lm --seq-shards 4 \
       --attention ring --dtype bfloat16
+  python -m distributed_model_parallel_tpu.cli.lm --moe-experts 8 \
+      --moe-dispatch hierarchical --moe-overlap --dcn-slices 2
 """
 
 from __future__ import annotations
@@ -103,6 +105,35 @@ def build_parser() -> argparse.ArgumentParser:
                             "ulysses_flash"),
                    help="*_flash = Pallas kernels as the attention core "
                         "(the long-context hot paths on TPU)")
+    p.add_argument("--moe-experts", default=0, type=int,
+                   help="Mixture-of-Experts: swap the FFN of every "
+                        "--moe-every-th decoder block for a routed MoE "
+                        "with this many experts (models/moe.py) and "
+                        "train under the expert-parallel LM engine; "
+                        "0 = dense (default)")
+    p.add_argument("--moe-every", default=2, type=int,
+                   help="which decoder blocks are MoE (1 = every "
+                        "layer, 2 = every other, ...)")
+    p.add_argument("--moe-dispatch", default="gspmd",
+                   choices=("gspmd", "hierarchical"),
+                   help="MoE token exchange: gspmd = experts sharded "
+                        "over an --expert-shards 'expert' mesh axis, "
+                        "flat all-to-all from the partitioner; "
+                        "hierarchical = experts ride the (--dcn-slices "
+                        "factored) data fabric through the explicit "
+                        "two-level moe_ring exchange — intra-slice "
+                        "all-to-all over 'ici', ONE cross-slice "
+                        "exchange on the 1/ici shard "
+                        "(ops/expert_dispatch.py)")
+    p.add_argument("--moe-overlap", action="store_true",
+                   help="chunk the hierarchical exchange so expert FFN "
+                        "compute on chunk k hides the communication of "
+                        "chunk k+1 (requires --moe-dispatch "
+                        "hierarchical; same math)")
+    p.add_argument("--expert-shards", default=1, type=int,
+                   help="'expert' mesh axis size (gspmd dispatch); "
+                        "hierarchical dispatch shards experts over the "
+                        "data fabric instead and requires 1")
     p.add_argument("--collective-matmul", action="store_true",
                    help="latency-hiding collective matmul (seq-parallel "
                         "mode): run each block's FFN pair as chunked "
@@ -174,6 +205,65 @@ def main(argv=None) -> dict:
         raise SystemExit(
             f"--microbatches must be >= 1, got {args.microbatches}"
         )
+    if args.moe_experts < 0:
+        raise SystemExit(
+            f"--moe-experts must be >= 0, got {args.moe_experts}"
+        )
+    if args.moe_experts == 0:
+        for flag, bad in (
+            ("--moe-dispatch", args.moe_dispatch != "gspmd"),
+            ("--moe-overlap", args.moe_overlap),
+            ("--expert-shards", args.expert_shards != 1),
+            ("--moe-every", args.moe_every != 2),
+        ):
+            if bad:
+                raise SystemExit(
+                    f"{flag} configures the MoE expert exchange; it "
+                    "has no effect without --moe-experts > 0"
+                )
+    else:
+        if args.seq_shards > 1 or args.pipeline_stages > 1:
+            raise SystemExit(
+                "--moe-experts trains under the expert-parallel LM "
+                "engine (GSPMD data x expert); it composes with "
+                "neither --seq-shards > 1 nor --pipeline-stages > 1 — "
+                "per-shard routing would break the dense capacity "
+                "semantics"
+            )
+        if args.collective_matmul:
+            raise SystemExit(
+                "--collective-matmul rings over the 'seq' axis of the "
+                "sequence-parallel engine; it has no effect under "
+                "--moe-experts"
+            )
+        if args.attention != "ring":
+            # Same principle as the pipeline branch: --attention picks
+            # a 'seq'-axis distribution pattern; the MoE LM attends
+            # dense causal, and silently training dense while the flag
+            # promises a flash kernel would mislabel every number.
+            raise SystemExit(
+                "--attention selects the sequence-parallel "
+                "distribution and has no effect under --moe-experts "
+                "(the MoE LM attends locally, dense causal); drop the "
+                "flag"
+            )
+        if args.grad_reduction != "monolithic":
+            raise SystemExit(
+                "--grad-reduction bucketed/overlapped addresses the "
+                "sequence-parallel engine's explicit reducer; the "
+                "expert-parallel LM engine is GSPMD — drop the flag"
+            )
+        if args.moe_overlap and args.moe_dispatch != "hierarchical":
+            raise SystemExit(
+                "--moe-overlap chunks the hierarchical exchange; set "
+                "--moe-dispatch hierarchical"
+            )
+        if args.moe_dispatch == "hierarchical" and args.expert_shards != 1:
+            raise SystemExit(
+                "--moe-dispatch hierarchical shards experts over the "
+                "(factored) data fabric; --expert-shards must stay 1 "
+                "(the 'expert' axis is the gspmd layout)"
+            )
     check_grad_reduction_args(args)
     check_checkpoint_args(args)
     if args.pipeline_stages > 1 and (
@@ -216,6 +306,24 @@ def main(argv=None) -> dict:
         check_batch_divisibility(
             args.batch_size, mesh, microbatches=args.microbatches
         )
+    elif args.moe_experts > 0:
+        mesh = make_mesh(MeshSpec(
+            data=-1, expert=args.expert_shards, dcn=args.dcn_slices,
+        ))
+        check_batch_divisibility(args.batch_size, mesh)
+        if args.moe_dispatch == "hierarchical":
+            from distributed_model_parallel_tpu.runtime.mesh import (
+                data_axis_size,
+            )
+
+            ways = data_axis_size(mesh)
+            if args.moe_experts % ways:
+                raise SystemExit(
+                    f"--moe-dispatch hierarchical shards "
+                    f"--moe-experts {args.moe_experts} over the "
+                    f"{ways}-way data fabric; the count must divide "
+                    "evenly (each device owns an E/S expert block)"
+                )
     else:
         mesh = make_mesh(MeshSpec(
             data=-1, seq=args.seq_shards, dcn=args.dcn_slices,
@@ -235,6 +343,8 @@ def main(argv=None) -> dict:
         max_position=args.seq_len,
         dropout_rate=args.dropout,
         pad_token_id=0,
+        num_experts=args.moe_experts,
+        moe_every=args.moe_every,
     )
     if args.pipeline_stages > 1:
         from distributed_model_parallel_tpu.models.gpt import split_stages
@@ -252,6 +362,21 @@ def main(argv=None) -> dict:
             schedule=args.pipeline_schedule,
             virtual_stages=args.virtual_stages,
             pad_token_id=cfg.pad_token_id,
+        )
+    elif args.moe_experts > 0:
+        from distributed_model_parallel_tpu.models.gpt import gpt_lm
+        from distributed_model_parallel_tpu.parallel.expert_parallel import (
+            ExpertParallelLMEngine,
+        )
+
+        engine = ExpertParallelLMEngine(
+            gpt_lm(cfg, remat=args.remat),
+            build_optimizer(args),
+            mesh,
+            dispatch=args.moe_dispatch,
+            overlap=args.moe_overlap,
+            pad_token_id=cfg.pad_token_id,
+            compute_dtype=compute_dtype_from_flag(args.dtype),
         )
     else:
         engine = CausalLMSequenceParallelEngine(
@@ -303,6 +428,9 @@ def main(argv=None) -> dict:
             "num_heads": cfg.num_heads,
             "ffn_dim": cfg.ffn_dim,
             "max_position": cfg.max_position,
+            # serve --checkpoint refuses MoE checkpoints by this field
+            # (the serving engine builds dense blocks).
+            "num_experts": cfg.num_experts,
         }},
     )
     trainer = Trainer(engine, train, val, tcfg, rng=jax.random.PRNGKey(0))

@@ -152,6 +152,13 @@ def _checkpoint_guard(directory: str, name: str, cfg) -> None:
     recorded = meta.get("gpt_config")
     if not recorded:
         return
+    if int(recorded.get("num_experts", 0)) > 0:
+        raise SystemExit(
+            f"--checkpoint {directory}: the checkpoint is a "
+            f"Mixture-of-Experts LM (num_experts="
+            f"{recorded['num_experts']}); the serving engine builds "
+            "dense decoder blocks and cannot serve it"
+        )
     for field, flag in _GPT_CONFIG_FLAGS.items():
         if field not in recorded:
             continue

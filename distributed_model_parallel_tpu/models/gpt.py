@@ -54,6 +54,16 @@ class GPTConfig:
     # use `lm_loss_fn(cfg)` (or pass pad_token_id to `lm_loss`) so pad
     # targets are masked there too.
     pad_token_id: Optional[int] = None
+    # Mixture-of-Experts: num_experts > 0 swaps the FFN of every
+    # `moe_every`-th decoder block for a routed MoE (`models/moe.py`,
+    # same alternating recipe as BertConfig). Train with the EP engines
+    # (`parallel/expert_parallel.ExpertParallelLMEngine`; the
+    # sequence-parallel LM engine computes its loss per shard and
+    # refuses MoE configs).
+    num_experts: int = 0
+    moe_every: int = 2
+    moe_top_k: int = 2
+    moe_capacity_factor: float = 1.25
 
 
 def stem_apply(params, ids, cfg: GPTConfig, drop: L.Layer, ctx, *,
@@ -122,13 +132,32 @@ def decoder_blocks(
     cfg: GPTConfig, attention_fn: Optional[AttentionFn] = None
 ) -> List[L.Layer]:
     attn = attention_fn or partial(dot_product_attention, causal=True)
-    return [
-        encoder_layer(
-            cfg.dim, cfg.num_heads, cfg.ffn_dim,
-            dropout_rate=cfg.dropout_rate, eps=1e-5, attention_fn=attn,
+    if cfg.num_experts > 0 and cfg.moe_every < 1:
+        raise ValueError(
+            f"moe_every must be >= 1 when num_experts > 0, got "
+            f"{cfg.moe_every} (1 = every layer, 2 = every other, ...)"
         )
-        for _ in range(cfg.num_layers)
-    ]
+    blocks = []
+    for i in range(cfg.num_layers):
+        if cfg.num_experts > 0 and (i + 1) % cfg.moe_every == 0:
+            from distributed_model_parallel_tpu.models.moe import (
+                moe_encoder_layer,
+            )
+
+            blocks.append(moe_encoder_layer(
+                cfg.dim, cfg.num_heads, cfg.ffn_dim, cfg.num_experts,
+                top_k=cfg.moe_top_k,
+                capacity_factor=cfg.moe_capacity_factor,
+                dropout_rate=cfg.dropout_rate, eps=1e-5,
+                attention_fn=attn,
+            ))
+        else:
+            blocks.append(encoder_layer(
+                cfg.dim, cfg.num_heads, cfg.ffn_dim,
+                dropout_rate=cfg.dropout_rate, eps=1e-5,
+                attention_fn=attn,
+            ))
+    return blocks
 
 
 def gpt_lm(

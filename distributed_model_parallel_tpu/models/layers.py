@@ -61,6 +61,13 @@ class Context:
     # transformer-family projection layers consume it via `project`.
     # None => every projection is a plain dot (the default everywhere).
     matmul: Optional[Any] = None
+    # Hand-rolled MoE token-exchange policy
+    # (`ops.expert_dispatch.ExpertDispatch` / `LocalExpertDispatch`)
+    # threaded by the EP/DDP engines when `dispatch="hierarchical"`;
+    # `models/moe.py` routes its expert FFN through it. None => the
+    # dense-dispatch einsums run whole and the partitioner inserts
+    # whatever flat exchange it likes (the GSPMD default).
+    expert_dispatch: Optional[Any] = None
 
     def child(self, i: int) -> "Context":
         """Context for the i-th child of a combinator: folds the child

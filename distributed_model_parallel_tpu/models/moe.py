@@ -8,7 +8,11 @@ chosen FOR the TPU: routing is expressed as einsums against one-hot
 dispatch/combine tensors — static shapes, no gather/scatter, everything
 on the MXU — so under GSPMD the expert dimension shards over the
 `'expert'` mesh axis and the partitioner inserts the token all-to-alls
-that GPU MoE stacks hand-write.
+that GPU MoE stacks hand-write. When an engine threads a policy into
+`Context.expert_dispatch` (`ExpertParallelEngine(dispatch=
+"hierarchical")` / the DDP engines' `expert_dispatch` knob), the expert
+FFN instead runs through the hand-rolled two-level exchange of
+`ops/expert_dispatch.py` — routing math here is untouched either way.
 
 Mechanics per token (top-k routing with capacity):
   * router logits -> softmax gates (f32), masked tokens zeroed;
@@ -46,6 +50,22 @@ from distributed_model_parallel_tpu.models.transformer import (
 from distributed_model_parallel_tpu.ops.attention import dot_product_attention
 
 AUX_KEY = "moe_aux"
+
+
+def expert_ffn(w, xin, dtype=None):
+    """The per-expert FFN (dense -> gelu -> dense), batched over the
+    leading expert axis: xin (E', rows, C, D) -> (E', rows, C, D) with
+    weight leaves leading E'. E' is the FULL expert stack on the GSPMD
+    path and a device's E/S block inside the hand-rolled exchange
+    (`ops/expert_dispatch.py`) — one copy of the math, no drift.
+    Params are f32 masters cast per-use to the compute dtype."""
+    dt = dtype if dtype is not None else xin.dtype
+    y = jnp.einsum("ebcd,edh->ebch", xin, w["w_in"].astype(dt))
+    y = jax.nn.gelu(
+        y + w["b_in"][:, None, None, :].astype(dt), approximate=False
+    )
+    y = jnp.einsum("ebch,ehd->ebcd", y, w["w_out"].astype(dt))
+    return y + w["b_out"][:, None, None, :].astype(dt)
 
 
 def moe_feed_forward(
@@ -141,16 +161,29 @@ def moe_feed_forward(
         dispatch = (combine > 0).astype(h.dtype)
 
         w = params["experts"]
-        xin = jnp.einsum("btec,btd->ebcd", dispatch, h)
-        y = jnp.einsum("ebcd,edh->ebch", xin, w["w_in"].astype(h.dtype))
-        y = jax.nn.gelu(
-            y + w["b_in"][:, None, None, :].astype(h.dtype),
-            approximate=False,
-        )
-        y = jnp.einsum("ebch,ehd->ebcd", y, w["w_out"].astype(h.dtype))
-        y = y + w["b_out"][:, None, None, :].astype(h.dtype)
-        out = jnp.einsum("btec,ebcd->btd", combine.astype(h.dtype), y)
-        out, _ = drop.apply({}, {}, out, ctx)
+        if ctx.expert_dispatch is not None:
+            # Hand-rolled hierarchical token exchange
+            # (`ops/expert_dispatch.py`): the policy runs the same
+            # pack -> FFN -> unpack math with the (E, B, C, D) buffers
+            # physically moved over explicit moe_ring permutes instead
+            # of a partitioner-inserted flat all-to-all. Routing above
+            # is per-sample, so it stays on the GSPMD side untouched.
+            out = ctx.expert_dispatch(
+                h, dispatch, combine.astype(h.dtype), w
+            )
+        else:
+            xin = jnp.einsum("btec,btd->ebcd", dispatch, h)
+            y = expert_ffn(w, xin, dtype=h.dtype)
+            out = jnp.einsum(
+                "btec,ebcd->btd", combine.astype(h.dtype), y
+            )
+        # Dedicated child lane for the one stochastic site: drawing from
+        # the parent ctx rng reused the lane the enclosing block already
+        # handed out, correlating the MoE mask with sibling layers'
+        # masks; child(1) mirrors the composed-model global-index
+        # contract `stage_apply_fns` reproduces (pinned in
+        # tests/test_expert_parallel.py).
+        out, _ = drop.apply({}, {}, out, ctx.child(1))
 
         # Switch load-balance loss: E * Σ_e (assigned fraction f_e) ·
         # (mean router prob p_e), over VALID tokens. f_e counts the

@@ -295,6 +295,21 @@ class DDPEngine:
     # of model blocks)); cuts reuse the pipeline engines' block
     # partitioning (`models/staging.split_points`).
     overlap_stages: int = 0
+    # MoE expert dispatch inside the shard_map step. None (default):
+    # every replica computes ALL experts' dense einsums locally (plain
+    # data parallelism). "hierarchical": the expert FFN is sharded 1/S
+    # over the data fabric through the explicit two-level moe_ring
+    # exchange (`ops/expert_dispatch.LocalExpertDispatch` — the
+    # shard_map-level policy: weights stay replicated in storage, each
+    # shard slices its E/S block by fabric index, and the data-axis
+    # gradient reduction reassembles the block-disjoint cotangents).
+    # Composes with grad_reduction="overlapped": the stagewise VJP's
+    # per-stage moe_aux cotangent channel carries the router penalty
+    # while each segment's bucket rings fire eagerly.
+    expert_dispatch: Optional[str] = None
+    # Chunk the hierarchical exchange so per-chunk expert FFN compute
+    # overlaps the next hop (expert_dispatch="hierarchical" only).
+    expert_overlap: bool = False
 
     def __post_init__(self):
         if self.grad_reduction not in (
@@ -303,6 +318,16 @@ class DDPEngine:
             raise ValueError(
                 "grad_reduction must be 'monolithic', 'bucketed' or "
                 f"'overlapped', got {self.grad_reduction!r}"
+            )
+        if self.expert_dispatch not in (None, "hierarchical"):
+            raise ValueError(
+                "expert_dispatch must be None or 'hierarchical', got "
+                f"{self.expert_dispatch!r}"
+            )
+        if self.expert_overlap and self.expert_dispatch is None:
+            raise ValueError(
+                "expert_overlap=True chunks the hierarchical MoE "
+                "exchange; set expert_dispatch='hierarchical'"
             )
         overlapped = self.grad_reduction == "overlapped"
         if overlapped:
@@ -323,6 +348,16 @@ class DDPEngine:
         model = self.model
         bucketed = self.grad_reduction == "bucketed"
         bucket_mb = self.bucket_mb
+        ed = None
+        if self.expert_dispatch == "hierarchical":
+            from distributed_model_parallel_tpu.ops.expert_dispatch import (
+                LocalExpertDispatch,
+            )
+
+            ed = LocalExpertDispatch(
+                ici_axis=ici_axis, dcn_axis=dcn_axis,
+                overlap=self.expert_overlap,
+            )
 
         @partial(
             shard_map,
@@ -344,7 +379,8 @@ class DDPEngine:
                 _apply_input_transform(tf, images, ts.step, True), cdt
             )
             ctx = Context(
-                train=True, bn_axis=bn_axis, rng=rng, dtype=cdt
+                train=True, bn_axis=bn_axis, rng=rng, dtype=cdt,
+                expert_dispatch=ed,
             )
 
             if overlapped:
@@ -427,7 +463,7 @@ class DDPEngine:
             )
             logits, _ = self.model.apply(
                 ts.params, ts.model_state, images_c,
-                Context(train=False, dtype=cdt),
+                Context(train=False, dtype=cdt, expert_dispatch=ed),
             )
             loss = cross_entropy(logits, labels)
             m = _metrics(loss, logits, labels)

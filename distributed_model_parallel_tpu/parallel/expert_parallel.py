@@ -1,44 +1,76 @@
-"""Expert parallelism over the `'expert'` mesh axis — GSPMD style.
+"""Expert parallelism — GSPMD over the `'expert'` mesh axis, or the
+hand-rolled hierarchical exchange over the factored data fabric.
 
 Absent from the reference (SURVEY.md §2.3: "EP — absent"); first-class
-here. Like the tensor-parallel engine (`parallel/tensor_parallel.py`),
-this is NOT a hand-written dispatch/collective stack: the MoE layer
-(`models/moe.py`) expresses routing as dense einsums against one-hot
-dispatch/combine tensors, so placing
+here. Two dispatch modes on one engine:
 
-    experts/w_in  (E, D, H)  -> P('expert', None, None)
-    experts/b_in  (E, H)     -> P('expert', None)
-    experts/w_out (E, H, D)  -> P('expert', None, None)
-    experts/b_out (E, D)     -> P('expert', None)
+* `dispatch="gspmd"` (default, the original path): like the
+  tensor-parallel engine, this is NOT a hand-written collective stack —
+  the MoE layer (`models/moe.py`) expresses routing as dense einsums
+  against one-hot dispatch/combine tensors, so placing
 
-on the weight pytree is sufficient: the XLA SPMD partitioner sees a
-token tensor sharded over 'data' meeting expert weights sharded over
-'expert' and inserts the token all-to-all exchange that GPU MoE
-frameworks (GShard, Switch, DeepSpeed-MoE) implement by hand — forward
-AND the mirrored gradient exchanges from the einsum transposes. Router
-weights and all non-expert parameters stay replicated.
+      experts/w_in  (E, D, H)  -> P('expert', None, None)
+      experts/b_in  (E, H)     -> P('expert', None)
+      experts/w_out (E, H, D)  -> P('expert', None, None)
+      experts/b_out (E, D)     -> P('expert', None)
+
+  on the weight pytree is sufficient: the XLA SPMD partitioner sees a
+  token tensor sharded over 'data' meeting expert weights sharded over
+  'expert' and inserts the token exchange that GPU MoE frameworks
+  (GShard, Switch, DeepSpeed-MoE) implement by hand — forward AND the
+  mirrored gradient exchanges from the einsum transposes. On a factored
+  `MeshSpec(dcn=K)` mesh that fused exchange drags the full token
+  payload across the slow fabric in (K-1)*I fragments.
+
+* `dispatch="hierarchical"` (+ `overlap=True`): the expert-parallel
+  world becomes the (factored) DATA fabric itself — DeepSpeed-MoE's
+  setting (Rajbhandari ICML'22, PAPERS.md). Expert weights shard 1/S on
+  their leading E axis over `data_axis_names(mesh)` (the EP memory win
+  kept; E % S == 0 required), the MoE FFN runs as a shard_map region
+  around the layer (`ops/expert_dispatch.ExpertDispatch`, threaded via
+  `Context.expert_dispatch`), and the token exchange is explicit:
+  intra-slice all-to-all over 'ici' first, ONE cross-slice exchange
+  over 'dcn' on the 1/ici-regrouped shard, every hop a tagged
+  `moe_ring` ppermute, the backward mirrored via custom_vjp.
+  `overlap=True` chunks the exchange so expert FFN compute on chunk k
+  hides the communication of chunk k+1 (same decomposition as
+  `ops/collective_matmul.py`). Pinned by hlolint rule
+  `moe-hierarchical-a2a`: zero token-sized all-to-all on the data
+  fabric, the exact tagged permute chain present.
 
 `ExpertParallelEngine` is the tensor-parallel engine with the expert
 rule set; concatenate `EXPERT_RULES + MEGATRON_RULES` on a
-(data, model, expert) mesh to run EP and TP together in one program.
-Per-device expert-weight bytes scale 1/E_mesh (tested in
-tests/test_expert_parallel.py), which is why EP exists.
+(data, model, expert) mesh to run EP and TP together in one program
+(gspmd mode). Per-device expert-weight bytes scale 1/E_mesh — 1/S over
+the data fabric in hierarchical mode — tested in
+tests/test_expert_parallel.py / tests/test_expert_dispatch.py.
+
+`ExpertParallelLMEngine` drives `models/gpt.gpt_lm` MoE stacks
+(GPTConfig(num_experts>0)) with the token-level next-token loss — the
+`--moe-experts`/`--moe-dispatch`/`--moe-overlap` surface of cli/lm.py.
 """
 
 from __future__ import annotations
 
 import dataclasses
-from typing import Sequence, Tuple
+from functools import partial
+from typing import Any, Optional, Sequence, Tuple
 
 from jax.sharding import PartitionSpec as P
 
+from distributed_model_parallel_tpu.parallel.data_parallel import (
+    _metrics,
+    _place_batch,
+)
 from distributed_model_parallel_tpu.parallel.tensor_parallel import (
     MEGATRON_RULES,
     TensorParallelEngine,
 )
+from distributed_model_parallel_tpu.runtime.mesh import data_axis_names
+from distributed_model_parallel_tpu.training.metrics import cross_entropy
 
 # Sharding layout for the stacked expert weights (models/moe.py param
-# paths: .../moe/experts/{w_in,b_in,w_out,b_out}).
+# paths: .../moe/experts/{w_in,b_in,w_out,b_out}) — gspmd mode.
 EXPERT_RULES: Tuple[Tuple[str, P], ...] = (
     (r"experts/w_in$", P("expert", None, None)),
     (r"experts/b_in$", P("expert", None)),
@@ -47,17 +79,117 @@ EXPERT_RULES: Tuple[Tuple[str, P], ...] = (
 )
 
 
+def hierarchical_expert_rules(mesh) -> Tuple[Tuple[str, P], ...]:
+    """The hierarchical-dispatch at-rest layout: expert stacks sharded
+    1/S on their leading E axis over the (factored) data axes — the
+    same fabric the `moe_ring` exchange runs over, so the shard_map
+    region's in_specs match at-rest placement and entry is free."""
+    dd = tuple(data_axis_names(mesh))
+    return (
+        (r"experts/w_in$", P(dd, None, None)),
+        (r"experts/b_in$", P(dd, None)),
+        (r"experts/w_out$", P(dd, None, None)),
+        (r"experts/b_out$", P(dd, None)),
+    )
+
+
 @dataclasses.dataclass
 class ExpertParallelEngine(TensorParallelEngine):
-    """GSPMD expert(+data) parallelism: expert weights sharded over
-    'expert' by path rules, batch over 'data', token all-to-alls from
-    the partitioner. Same API as every other engine."""
+    """Expert(+data) parallelism: GSPMD over 'expert' by path rules
+    (default), or the hand-rolled hierarchical dcn x ici exchange over
+    the data fabric (`dispatch="hierarchical"`, optionally
+    `overlap=True`). Same API as every other engine."""
 
     rules: Sequence[Tuple[str, P]] = EXPERT_RULES
+    # "gspmd": partitioner-inserted flat exchange over 'expert'.
+    # "hierarchical": explicit two-level moe_ring exchange over the
+    # (factored) data axes (`ops/expert_dispatch.py`); requires the
+    # 'expert' mesh axis at size 1 (experts ride the data fabric) and
+    # num_experts divisible by the data-fabric size.
+    dispatch: str = "gspmd"
+    # Chunk the hierarchical exchange so expert FFN compute on chunk k
+    # overlaps communication of chunk k+1 (hierarchical mode only; same
+    # math, same tagged hop count, different dependency structure).
+    overlap: bool = False
+
+    def __post_init__(self):
+        if self.dispatch not in ("gspmd", "hierarchical"):
+            raise ValueError(
+                "dispatch must be 'gspmd' or 'hierarchical', got "
+                f"{self.dispatch!r}"
+            )
+        if self.overlap and self.dispatch != "hierarchical":
+            raise ValueError(
+                "overlap=True chunks the hierarchical exchange; it has "
+                "no effect under dispatch='gspmd' — set "
+                "dispatch='hierarchical' or drop overlap"
+            )
+        if self.dispatch == "hierarchical":
+            if (
+                "expert" in self.mesh.axis_names
+                and int(self.mesh.shape["expert"]) > 1
+            ):
+                raise ValueError(
+                    "dispatch='hierarchical' rides the (factored) data "
+                    "fabric: experts shard over data_axis_names(mesh), "
+                    "not 'expert' — build the mesh with expert=1 (got "
+                    f"expert={int(self.mesh.shape['expert'])})"
+                )
+            from distributed_model_parallel_tpu.ops.expert_dispatch import (
+                ExpertDispatch,
+            )
+
+            # Swap the default 'expert'-axis layout for the data-fabric
+            # one (an explicit rules= override wins).
+            if self.rules is EXPERT_RULES:
+                self.rules = hierarchical_expert_rules(self.mesh)
+            self._expert_dispatch = ExpertDispatch(
+                self.mesh, overlap=self.overlap
+            )
+        super().__post_init__()
+
+
+@dataclasses.dataclass
+class ExpertParallelLMEngine(ExpertParallelEngine):
+    """Causal-LM pretraining under expert(+data) parallelism: the EP
+    engine with the token-level next-token loss — `gpt_lm(cfg)` stacks
+    with `GPTConfig(num_experts > 0)` MoE decoder blocks serve
+    directly. `shard_batch` builds targets on the HOST
+    (`models.gpt.lm_targets`) like the CausalLM-SP engine, so the two
+    text engines share one data contract."""
+
+    pad_token_id: Optional[int] = None
+
+    def __post_init__(self):
+        from distributed_model_parallel_tpu.models.gpt import lm_targets
+
+        self._lm_targets = partial(
+            lm_targets, pad_token_id=self.pad_token_id
+        )
+        super().__post_init__()
+
+    def loss_and_metrics(self, logits, targets):
+        """Next-token loss on the flattened token axis: logits
+        (B, T, V) + targets (B, T) -> the shared `_metrics` contract
+        (pad targets are -1, excluded everywhere)."""
+        b, t, v = logits.shape
+        flat_logits = logits.reshape(b * t, v)
+        flat_targets = targets.reshape(b * t)
+        ce = cross_entropy(flat_logits, flat_targets)
+        return ce, _metrics(ce, flat_logits, flat_targets)
+
+    def shard_batch(self, ids, labels=None):
+        """ids (B, T) -> (ids, next-token targets), both sharded over
+        the data axes. `labels` is ignored (the LM's targets are the
+        shifted ids — the LMLoader yields (ids, ids))."""
+        targets = self._lm_targets(ids)
+        return _place_batch((ids, targets), self._batch)
 
 
 __all__ = [
     "EXPERT_RULES",
     "MEGATRON_RULES",
     "ExpertParallelEngine",
+    "ExpertParallelLMEngine",
+    "hierarchical_expert_rules",
 ]

@@ -382,6 +382,17 @@ class CausalLMSequenceParallelEngine:
         overlapped = self.grad_reduction == "overlapped"
         bucket_mb = self.bucket_mb
         cfg = self.cfg
+        if getattr(cfg, "num_experts", 0) > 0:
+            # Same objection as the BERT SP engine: per-shard routing
+            # under 'seq' sharding breaks the dense capacity semantics
+            # and the moe_aux leaves never reach the differentiated
+            # loss. The MoE text path is ExpertParallelLMEngine.
+            raise NotImplementedError(
+                "GPTConfig.num_experts > 0 is not supported by "
+                "CausalLMSequenceParallelEngine; train MoE LMs with "
+                "parallel/expert_parallel.ExpertParallelLMEngine "
+                "(cli/lm.py --moe-experts)."
+            )
         if overlapped:
             if cfg.num_layers < 2:
                 raise ValueError(

@@ -144,6 +144,10 @@ class TensorParallelEngine:
                 ),
             )
         mm = self._matmul
+        # Hand-rolled MoE exchange policy, set by ExpertParallelEngine
+        # (dispatch="hierarchical") BEFORE delegating here; consumed by
+        # models/moe.py via Context.expert_dispatch.
+        ed = getattr(self, "_expert_dispatch", None)
         cdt = self.compute_dtype
         tf = self.input_transform
         model = self.model
@@ -157,19 +161,20 @@ class TensorParallelEngine:
             def loss_fn(params, model_state):
                 logits, new_state = model.apply(
                     params, model_state, inputs_c,
-                    Context(train=True, rng=rng, dtype=cdt, matmul=mm),
+                    Context(train=True, rng=rng, dtype=cdt, matmul=mm,
+                            expert_dispatch=ed),
                 )
-                ce = cross_entropy(logits, labels)
-                return ce + aux_loss(new_state), (new_state, logits, ce)
+                loss, m = self.loss_and_metrics(logits, labels)
+                return loss + aux_loss(new_state), (new_state, m)
 
-            (_, (new_state, logits, ce)), grads = jax.value_and_grad(
+            (_, (new_state, m)), grads = jax.value_and_grad(
                 loss_fn, has_aux=True
             )(ts.params, ts.model_state)
             params, opt_state = self.optimizer.update(
                 ts.params, ts.opt_state, grads, lr
             )
             new_ts = TrainState(params, new_state, opt_state, ts.step + 1)
-            return new_ts, _metrics(ce, logits, labels)
+            return new_ts, m
 
         def eval_step(ts: TrainState, inputs, labels):
             inputs_c = _cast_input(
@@ -177,10 +182,11 @@ class TensorParallelEngine:
             )
             logits, _ = self.model.apply(
                 ts.params, ts.model_state, inputs_c,
-                Context(train=False, dtype=cdt, matmul=mm),
+                Context(train=False, dtype=cdt, matmul=mm,
+                        expert_dispatch=ed),
             )
-            loss = cross_entropy(logits, labels)
-            return _metrics(loss, logits, labels)
+            _, m = self.loss_and_metrics(logits, labels)
+            return m
 
         # State shardings are fixed by the rules and the model structure
         # (known from an abstract trace of init); jit pins them in/out so
@@ -225,6 +231,15 @@ class TensorParallelEngine:
             in_shardings=(sh, self._batch, self._batch),
             out_shardings=self._repl,
         )
+
+    def loss_and_metrics(self, logits, labels):
+        """The differentiated loss + engine metrics for one batch —
+        classification cross-entropy here; `ExpertParallelLMEngine`
+        overrides with the token-level next-token loss. The scalar is
+        what `train_step` differentiates (MoE aux penalties are added
+        by the caller); metrics keep the `_metrics` psum contract."""
+        ce = cross_entropy(logits, labels)
+        return ce, _metrics(ce, logits, labels)
 
     def param_specs(self, p_aval):
         """PartitionSpec pytree for the parameters — rule-driven here;

@@ -80,6 +80,18 @@ BW_DCN_EFFECTIVE = 25e9  # bytes/s usable across the slice boundary
 # Cross-slice hop latency: DCN is a routed network, not a torus link.
 ALPHA_DCN_HOP_S = 10e-6
 BUCKET_MB = 25.0  # the reducer's default bucket_cap_mb
+# MoE dispatch (step 3c): one routed layer's token exchange, sized for
+# a GPT-MoE block — per-chip token load, model dim, top-k routing with
+# the Switch capacity factor. The dispatch buffer each device must
+# exchange is ~top_k * capacity_factor * tokens * dim bytes.
+MOE_TOKENS_PER_CHIP = 4096   # B*T tokens resident per chip
+MOE_DIM = 1024
+MOE_TOP_K = 2
+MOE_CAPACITY_FACTOR = 1.25
+MOE_FFN_HIDDEN = 4 * MOE_DIM
+# Per-chip dense-equivalent MXU throughput for hiding the exchange
+# (peak bf16 ~197 TF/s on v5e at a conservative 0.3 MFU).
+MOE_EFFECTIVE_FLOPS = 197e12 * 0.3
 
 
 def optimized_all_reduce_bytes(text):
@@ -260,6 +272,55 @@ def main():
           f"{eff_two_level:.3f} (hierarchical bucketed, no overlap) .. "
           f"{eff_two_level_overlap:.3f} (full overlap)")
 
+    # ---- 3c. two-level a2a: the hierarchical MoE token exchange ------
+    # One routed layer's dispatch+combine at 64 chips as DCN_SLICES x
+    # ici (`ops/expert_dispatch.py`). The FLAT all-to-all sends each of
+    # the S-1 partners X/S bytes: (K-1)*ici of those messages cross the
+    # slice boundary — the alpha term pays (K-1)*ici DCN hops and the
+    # full (K-1)/K of the payload rides DCN. The HIERARCHICAL exchange
+    # moves the same cross-slice bytes (tokens must cross) but as K-1
+    # contiguous messages of the 1/ici-regrouped shard — ici x fewer
+    # DCN hops — and the (ici-1)/ici intra-slice share rides ICI
+    # exclusively. OVERLAPPED additionally hides the exchange behind
+    # the per-chunk expert FFN (the chunked ppermute decomposition).
+    moe_x_bytes = int(
+        MOE_TOP_K * MOE_CAPACITY_FACTOR * MOE_TOKENS_PER_CHIP
+        * MOE_DIM * 2  # bf16 wire
+    )
+    # per-exchange (dispatch or combine), per device:
+    a2a_flat_s = (
+        (DCN_SLICES - 1) / DCN_SLICES * moe_x_bytes / BW_DCN_EFFECTIVE
+        + (ici - 1) / N * moe_x_bytes / BW_ICI_EFFECTIVE
+        + (DCN_SLICES - 1) * ici * ALPHA_DCN_HOP_S
+        + (ici - 1) * ALPHA_HOP_S
+    )
+    a2a_two_level_s = (
+        (DCN_SLICES - 1) / DCN_SLICES * moe_x_bytes / BW_DCN_EFFECTIVE
+        + (ici - 1) / ici * moe_x_bytes / BW_ICI_EFFECTIVE
+        + (DCN_SLICES - 1) * ALPHA_DCN_HOP_S
+        + (ici - 1) * ALPHA_HOP_S
+    )
+    # Expert FFN compute available to hide behind (per device, all its
+    # routed tokens through the two dense matmuls):
+    moe_ffn_flops = (
+        4 * MOE_TOP_K * MOE_CAPACITY_FACTOR * MOE_TOKENS_PER_CHIP
+        * MOE_DIM * MOE_FFN_HIDDEN
+    )
+    moe_ffn_s = moe_ffn_flops / MOE_EFFECTIVE_FLOPS
+    moe_layer_flat_s = 2 * a2a_flat_s + moe_ffn_s
+    moe_layer_two_level_s = 2 * a2a_two_level_s + moe_ffn_s
+    moe_layer_overlap_s = max(2 * a2a_two_level_s, moe_ffn_s)
+    print(f"MoE a2a ({DCN_SLICES}x{ici} dcn*ici, "
+          f"{moe_x_bytes/1e6:.1f} MB dispatch buffer/chip): "
+          f"flat {a2a_flat_s*1e3:.2f} ms/exchange "
+          f"({(DCN_SLICES-1)*ici} DCN hops) -> two-level "
+          f"{a2a_two_level_s*1e3:.2f} ms ({DCN_SLICES-1} DCN hop)")
+    print(f"per MoE layer (2 exchanges + FFN {moe_ffn_s*1e3:.2f} ms): "
+          f"flat {moe_layer_flat_s*1e3:.2f} ms, hierarchical "
+          f"{moe_layer_two_level_s*1e3:.2f} ms, overlapped "
+          f"{moe_layer_overlap_s*1e3:.2f} ms "
+          f"(exchange {'hidden' if moe_ffn_s >= 2*a2a_two_level_s else 'exposed'})")
+
     out = {
         "n_devices": N,
         "per_chip_batch": PER_CHIP_BATCH,
@@ -301,6 +362,16 @@ def main():
             eff_two_level, 4),
         "predicted_weak_scaling_eff_64_2slice_hierarchical_overlap":
             round(eff_two_level_overlap, 4),
+        # two-level MoE token-exchange row (ops/expert_dispatch.py)
+        "moe_dispatch_bytes_per_chip": moe_x_bytes,
+        "moe_a2a_flat_s": round(a2a_flat_s, 6),
+        "moe_a2a_two_level_s": round(a2a_two_level_s, 6),
+        "moe_ffn_s": round(moe_ffn_s, 6),
+        "moe_layer_flat_s": round(moe_layer_flat_s, 6),
+        "moe_layer_hierarchical_s": round(moe_layer_two_level_s, 6),
+        "moe_layer_overlapped_s": round(moe_layer_overlap_s, 6),
+        "moe_dcn_hops_flat": (DCN_SLICES - 1) * ici,
+        "moe_dcn_hops_hierarchical": DCN_SLICES - 1,
     }
     path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                         "scaling64.json")

@@ -307,6 +307,53 @@ def test_reducer_sweep_failure_rescues_partial_legs(
     assert out[0]["backend"] == "unreachable"
 
 
+def test_moe_microbench_flag_is_wired():
+    """`--moe-microbench` and its internal `--child-moe` parse (the
+    parent spawns exactly that argv); mutual exclusion with the other
+    sweeps holds."""
+    import os
+    import subprocess
+    import sys
+
+    env = {**os.environ, "JAX_PLATFORMS": "cpu"}
+    res = subprocess.run(
+        [sys.executable, os.path.abspath(bench.__file__), "--help"],
+        capture_output=True, text=True, timeout=60, env=env,
+    )
+    assert res.returncode == 0
+    assert "--moe-microbench" in res.stdout
+    assert "--child-moe" in res.stdout
+    res = subprocess.run(
+        [sys.executable, os.path.abspath(bench.__file__),
+         "--moe-microbench", "--reducer-microbench"],
+        capture_output=True, text=True, timeout=60, env=env,
+    )
+    assert res.returncode != 0
+    assert "mutually exclusive" in res.stderr
+
+
+def test_moe_sweep_failure_rescues_partial_legs(monkeypatch, capsys):
+    """The MoE dispatch sweep rides the same per-leg rescue convention
+    as the other sweeps (flat/hierarchical/overlapped columns are plain
+    row keys to the rescue path)."""
+    legs = [{"axis_size": 2, "flat_ms": 1.0, "hierarchical_ms": 0.9,
+             "overlapped_ms": 0.8}]
+
+    def fake_spawn(args, timeout_s, env=None, **kw):
+        out = "".join(
+            json.dumps({"leg": leg, "partial": True}) + "\n"
+            for leg in legs
+        )
+        return None, out, "child killed after timeout"
+
+    monkeypatch.setattr(bench, "_spawn", fake_spawn)
+    bench._run_sweep_child(["--child-moe"], None, "moe_microbench")
+    out = _parse_lines(capsys.readouterr().out)
+    assert len(out) == 1
+    assert out[0]["moe_microbench"] == legs
+    assert out[0]["backend"] == "unreachable"
+
+
 def test_checkpoint_microbench_flag_is_wired():
     """`--checkpoint-microbench` and its internal `--child-checkpoint`
     parse (the parent spawns exactly that argv); mutual exclusion with

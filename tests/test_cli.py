@@ -292,6 +292,82 @@ def test_lm_cli_overlapped(tmp_path, monkeypatch):
     assert len(result["history"]) == 1
 
 
+def test_lm_cli_moe_flag_guards():
+    """The MoE flag surface fails fast with CLI vocabulary: exchange
+    knobs without --moe-experts, MoE under seq/pipeline parallelism,
+    overlap without hierarchical, expert-shards under hierarchical,
+    reducer flags on the GSPMD EP engine, indivisible expert counts."""
+    from distributed_model_parallel_tpu.cli import lm
+
+    with pytest.raises(SystemExit):  # knob without --moe-experts
+        lm.main(["--moe-dispatch", "hierarchical"])
+    with pytest.raises(SystemExit):
+        lm.main(["--moe-overlap"])
+    with pytest.raises(SystemExit):
+        lm.main(["--expert-shards", "2"])
+    with pytest.raises(SystemExit):  # MoE x seq parallelism
+        lm.main(["--moe-experts", "8", "--seq-shards", "2"])
+    with pytest.raises(SystemExit):  # MoE x pipeline
+        lm.main(["--moe-experts", "8", "--pipeline-stages", "2"])
+    with pytest.raises(SystemExit):  # overlap needs hierarchical
+        lm.main(["--moe-experts", "8", "--moe-overlap"])
+    with pytest.raises(SystemExit):  # hierarchical x expert-shards
+        lm.main([
+            "--moe-experts", "8", "--moe-dispatch", "hierarchical",
+            "--expert-shards", "2",
+        ])
+    with pytest.raises(SystemExit):  # EP engine is GSPMD — no reducer
+        lm.main([
+            "--moe-experts", "8", "--grad-reduction", "bucketed",
+        ])
+    with pytest.raises(SystemExit):  # MoE attends dense causal — a
+        lm.main([                    # requested flash core would be
+            "--moe-experts", "8",    # silently dropped
+            "--attention", "ulysses_flash",
+        ])
+    with pytest.raises(SystemExit):  # 6 experts on the 8-way fabric
+        lm.main([
+            "--moe-experts", "6", "--moe-dispatch", "hierarchical",
+        ])
+
+
+def test_lm_cli_moe_hierarchical(tmp_path, monkeypatch):
+    """--moe-experts --moe-dispatch hierarchical --moe-overlap drives
+    the expert-parallel LM engine end-to-end on the hybrid dcn x ici
+    fabric (the PR 10 tentpole's CLI surface)."""
+    from distributed_model_parallel_tpu.cli import lm
+
+    monkeypatch.chdir(tmp_path)
+    result = lm.main([
+        "--moe-experts", "8", "--moe-dispatch", "hierarchical",
+        "--moe-overlap", "--dcn-slices", "2",
+        "--dim", "16", "--layers", "2", "--heads", "2",
+        "--ffn-dim", "32", "--seq-len", "16",
+        "-b", "8", "--epochs", "1", "--steps-per-epoch", "2",
+        "--corpus-tokens", "4096", "--lr", "1e-3",
+    ])
+    assert len(result["history"]) == 1
+
+
+@pytest.mark.slow
+def test_lm_cli_moe_gspmd(tmp_path, monkeypatch):
+    """--moe-experts with the default gspmd dispatch drives the
+    'expert'-axis layout end-to-end. `slow`; tier-1 twins: the
+    hierarchical CLI row above and the engine-level parity in
+    tests/test_expert_dispatch.py."""
+    from distributed_model_parallel_tpu.cli import lm
+
+    monkeypatch.chdir(tmp_path)
+    result = lm.main([
+        "--moe-experts", "4", "--expert-shards", "4",
+        "--dim", "16", "--layers", "2", "--heads", "2",
+        "--ffn-dim", "32", "--seq-len", "16",
+        "-b", "8", "--epochs", "1", "--steps-per-epoch", "2",
+        "--corpus-tokens", "4096", "--lr", "1e-3",
+    ])
+    assert len(result["history"]) == 1
+
+
 @pytest.mark.slow
 def test_lm_cli_collective_matmul(tmp_path, monkeypatch):
     """The lm CLI's --collective-matmul reaches the sequence-parallel

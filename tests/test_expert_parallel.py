@@ -304,6 +304,83 @@ def test_moe_every_zero_rejected():
         bert_for_classification(4, cfg)
 
 
+def test_moe_dropout_draws_from_its_own_child_lane():
+    """Regression (PR 10 satellite): the MoE output dropout reused the
+    PARENT ctx rng — the lane the enclosing block had already handed
+    out — correlating its mask with sibling layers'. It must draw from
+    the dedicated child(1) lane: the mask equals a bernoulli from
+    fold_in(rng, 1), and differs from one drawn on the raw parent
+    rng."""
+    rate = 0.5
+    moe = moe_feed_forward(D, 2 * D, 2, top_k=1, capacity_factor=2.0,
+                           dropout_rate=rate)
+    p, s = moe.init(jax.random.PRNGKey(0))
+    h = _tokens(7)
+    rng = jax.random.PRNGKey(42)
+    (y_clean, _), _ = moe.apply(
+        p, s, (h, None), L.Context(train=False)
+    )
+    (y_drop, _), _ = moe.apply(
+        p, s, (h, None), L.Context(train=True, rng=rng)
+    )
+
+    def masked(key):
+        keep = jax.random.bernoulli(key, 1.0 - rate, y_clean.shape)
+        return np.where(np.asarray(keep),
+                        np.asarray(y_clean) / (1.0 - rate), 0.0)
+
+    np.testing.assert_allclose(
+        np.asarray(y_drop),
+        masked(jax.random.fold_in(rng, 1)),
+        rtol=1e-6, atol=1e-6,
+    )
+    assert np.abs(
+        np.asarray(y_drop) - masked(rng)
+    ).max() > 1e-3, "mask still drawn from the parent lane"
+
+
+def test_staged_moe_dropout_matches_composed_apply():
+    """The stage_apply_fns global-index contract survives the dropout
+    lane fix: a staged MoE model's stagewise forward draws bit-identical
+    masks to the composed model's (same Context.child chain)."""
+    from distributed_model_parallel_tpu.models import staging
+
+    stem_lin = L.linear(D, D)
+
+    def stem_apply(params, state, x, ctx):
+        h, _ = stem_lin.apply(params, state, x, ctx)
+        return (h, None), {}
+
+    head_lin = L.linear(D, 4)
+
+    def head_apply(params, state, x, ctx):
+        h, _ = x
+        return head_lin.apply(params, state, h.mean(axis=1), ctx)
+
+    blocks = [
+        moe_encoder_layer(D, 4, 2 * D, 2, top_k=1, dropout_rate=0.3)
+        for _ in range(2)
+    ]
+    model = staging.staged_model(
+        L.Layer(stem_lin.init, stem_apply), blocks,
+        L.Layer(head_lin.init, head_apply),
+    )
+    params, state = model.init(jax.random.PRNGKey(0))
+    x = _tokens(9)
+    ctx = L.Context(train=True, rng=jax.random.PRNGKey(7))
+    composed, _ = model.apply(params, state, x, ctx)
+    cuts = staging.split_points(2, None, len(blocks))
+    fns = staging.stage_apply_fns(model.parts, cuts, ctx)
+    y = x
+    for fn, sp, ss in zip(
+        fns,
+        staging.partition_tree(params, cuts),
+        staging.partition_tree(state, cuts),
+    ):
+        y, _ = fn(sp, ss, y)
+    np.testing.assert_array_equal(np.asarray(composed), np.asarray(y))
+
+
 def test_rules_require_expert_axis():
     mesh = make_mesh(MeshSpec(data=8))  # no expert axis sized > 1 is fine;
     # the axis exists in AXES, so construction succeeds and shards E over

@@ -33,6 +33,8 @@ ICI_PAIRS = "{0,1},{1,2},{2,3},{3,0},{4,5},{5,6},{6,7},{7,4}"
 DATA_PAIRS = "{0,1},{1,2},{2,3},{3,4},{4,5},{5,6},{6,7},{7,0}"
 ICI_GROUPS = "{{0,1,2,3},{4,5,6,7}}"
 DCN_GROUPS = "{{0,4},{1,5},{2,6},{3,7}}"
+# permute pairs that stay WITHIN 'dcn' on MESH_2x4 (cross-slice hops)
+DCN_PAIRS_2x4 = "{0,4},{4,0},{1,5},{5,1},{2,6},{6,2},{3,7},{7,3}"
 M4_PAIRS = "{0,1},{1,2},{2,3},{3,0}"
 
 
@@ -529,6 +531,82 @@ def test_bf16_ring_upcast_requires_jaxpr_data():
         MESH8,
     )
     assert found and "not checked" in found[0].message
+
+
+# ------------------------------------------------ moe-hierarchical-a2a
+
+
+def alltoall(name, operand, groups, shape="f32[16]"):
+    return (
+        "%{n} = {s}{{0}} all-to-all({s}{{0}} %{o}), "
+        "replica_groups={g}, use_global_device_ids=true".format(
+            n=name, s=shape, o=operand, g=groups
+        )
+    )
+
+
+def moe_perm(name, operand, pairs, tag="moe_ring"):
+    return perm(name, operand, pairs, tag=tag)
+
+
+def moe_target(**kw):
+    base = dict(
+        name="t", engine="ep", moe_dispatch="hierarchical",
+        data_axes=("dcn", "ici"), ici_axis="ici", dcn_axis="dcn",
+        ici_size=4, dcn_size=2,
+        # 1 MoE layer on a 2x4 fabric: 2*(2*(4-1) + 2*(2-1)) = 16.
+        moe_ring_permutes=16,
+    )
+    base.update(kw)
+    return LintTarget(**base)
+
+
+@pytest.mark.hlo_rule("moe-hierarchical-a2a", "positive")
+def test_moe_hierarchical_fires_on_flat_a2a_and_short_chain():
+    # A surviving all-to-all over 'dcn' plus only one tagged hop: both
+    # halves of the contract violated.
+    lines = [
+        alltoall("a2a", "p", DCN_GROUPS),
+        moe_perm("cp0", "p", ICI_PAIRS),
+    ]
+    found = check("moe-hierarchical-a2a", moe_target(), module(lines),
+                  MESH_2x4)
+    msgs = " | ".join(f.message for f in found)
+    assert "expected exactly 16" in msgs
+    assert "all-to-all touching the data fabric" in msgs
+
+
+@pytest.mark.hlo_rule("moe-hierarchical-a2a", "negative")
+def test_moe_hierarchical_tagged_chain_is_clean():
+    # 12 ici hops + 4 dcn hops (2 exchanges' worth fwd+bwd on 2x4,
+    # transpose-spelled scopes included), no all-to-all anywhere.
+    lines = (
+        [moe_perm(f"ci{i}", "p", ICI_PAIRS) for i in range(9)]
+        + [perm(f"ct{i}", "p", ICI_PAIRS, tag="transpose(moe_ring)")
+           for i in range(3)]
+        + [moe_perm(f"cd{i}", "p", DCN_PAIRS_2x4) for i in range(4)]
+    )
+    assert check(
+        "moe-hierarchical-a2a", moe_target(), module(lines), MESH_2x4
+    ) == []
+
+
+def test_moe_hierarchical_missing_expectation_is_a_finding():
+    found = check(
+        "moe-hierarchical-a2a", moe_target(moe_ring_permutes=None),
+        module([]), MESH_2x4,
+    )
+    assert found and "not checked" in found[0].message
+
+
+def test_moe_hierarchical_untagged_permutes_do_not_count():
+    # The right hop count but none scoped moe_ring: the chain pin must
+    # fire (GSPMD resharding permutes are not the exchange).
+    lines = [perm(f"cp{i}", "p", ICI_PAIRS) for i in range(16)]
+    found = check(
+        "moe-hierarchical-a2a", moe_target(), module(lines), MESH_2x4
+    )
+    assert found and "0 moe_ring-scoped" in found[0].message
 
 
 # ------------------------------------------------- donated-step-aliased

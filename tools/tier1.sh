@@ -18,8 +18,9 @@
 #     mid-run; the main pass still carries
 #     --continue-on-collection-errors as a belt-and-braces backstop,
 #   * an `hlolint` PRE-GATE (tools/hlolint --pregate, exit 3): the
-#     collective-contract linter over tinycnn DDP/FSDP overlapped, so a
-#     broken ring/fabric/overlap contract fails in seconds with the
+#     collective-contract linter over tinycnn DDP/FSDP overlapped plus
+#     the tinycnn-sized hierarchical-MoE combo, so a broken
+#     ring/fabric/overlap/dispatch contract fails in seconds with the
 #     violated rule named (INTERNALS.md section 8b has the catalog),
 #   * 870 s budget with a hard kill 10 s later,
 #   * DOTS_PASSED=<n> printed from the progress dots as a
@@ -54,11 +55,12 @@ fi
 echo "[tier1] collection ok:" \
   "$(grep -cE '::' /tmp/_t1_collect.log || true) tests collected"
 
-# hlolint pre-gate (mirrors the --collect-only pre-gate): lint the two
+# hlolint pre-gate (mirrors the --collect-only pre-gate): lint the
 # deepest-rule-stack combos (tinycnn DDP + FSDP overlapped — rings,
-# overlap deps, BN allowlist, at-rest sharding) BEFORE the suite, so a
-# broken collective contract fails in seconds with the violated rule
-# NAMED instead of as a slow structural-test failure mid-run. Exit 3
+# overlap deps, BN allowlist, at-rest sharding — plus the tinycnn-sized
+# hierarchical-MoE dispatch combo) BEFORE the suite, so a broken
+# collective contract fails in seconds with the violated rule NAMED
+# instead of as a slow structural-test failure mid-run. Exit 3
 # distinguishes a contract violation from a collection failure (2).
 rm -f /tmp/_t1_hlolint.log
 if ! timeout -k 5 300 bash tools/hlolint --pregate \
