@@ -871,6 +871,7 @@ def run_child_reducer(max_devices: int, platform: str = "cpu") -> None:
         mlp_x = jnp.asarray(rng.randn(8 * size, 64), jnp.float32)
         row = {
             "axis_size": size,
+            "wire": "f32",
             "naive_ms": round(time_fn(naive), 3),
             "bucketed_ms": round(time_fn(bucketed), 3),
             "hierarchical_ms": round(time_fn(hierarchical), 3),
@@ -894,6 +895,33 @@ def run_child_reducer(max_devices: int, platform: str = "cpu") -> None:
             f"{row['overlapped_ms']}ms")
         # Per-leg partial line (same convention as the other sweeps).
         print(json.dumps({"leg": row, "partial": True}), flush=True)
+        # Quantized-wire rows (ops/wire_codec.py): the SAME
+        # hierarchical reduction with the cross-slice hop compressed —
+        # the only leg the wire dtype touches, so the f32 columns are
+        # not re-timed. On the CPU mesh the encode/decode ADDS work
+        # (no real slow fabric to save); the column exists so a real
+        # slice fills it in (the byte story is pinned by hlolint
+        # dcn-compressed-payload either way).
+        for wire in ("bf16", "int8"):
+            hier_w = reducer(
+                hier_mesh,
+                partial(bucketed_pmean, ici_axis="ici",
+                        dcn_axis="dcn", bucket_mb=bucket_mb,
+                        dcn_compression=wire),
+            )
+            wrow = {
+                "axis_size": size,
+                "wire": wire,
+                "hierarchical_ms": round(time_fn(hier_w), 3),
+            }
+            wrow["hierarchical_speedup"] = round(
+                row["naive_ms"] / max(wrow["hierarchical_ms"], 1e-9), 3
+            )
+            rows.append(wrow)
+            log(f"S={size} wire={wire}: hierarchical "
+                f"{wrow['hierarchical_ms']}ms")
+            print(json.dumps({"leg": wrow, "partial": True}),
+                  flush=True)
 
     out = {
         "reducer_microbench": rows,
@@ -1027,9 +1055,10 @@ def run_child_moe(max_devices: int, platform: str = "cpu") -> None:
             ("dcn", "ici"),
         )
 
-        def hier_body(xl, wl, overlap):
+        def hier_body(xl, wl, overlap, wire="none"):
             return exchanged_expert_ffn(
-                xl, partial(expert_ffn, wl), "ici", "dcn", overlap
+                xl, partial(expert_ffn, wl), "ici", "dcn", overlap,
+                wire,
             )
 
         hierarchical = build(
@@ -1042,6 +1071,7 @@ def run_child_moe(max_devices: int, platform: str = "cpu") -> None:
         )
         row = {
             "axis_size": size,
+            "wire": "f32",
             "flat_ms": round(time_fn(flat), 3),
             "hierarchical_ms": round(time_fn(hierarchical), 3),
             "overlapped_ms": round(time_fn(overlapped), 3),
@@ -1058,6 +1088,38 @@ def run_child_moe(max_devices: int, platform: str = "cpu") -> None:
             f"{row['overlapped_ms']}ms")
         # Per-leg partial line (same convention as the other sweeps).
         print(json.dumps({"leg": row, "partial": True}), flush=True)
+        # Quantized-wire rows: the two-level exchange with its 'dcn'
+        # messages compressed (`ops/wire_codec.py`) — same hop
+        # structure, 1/2 resp. 1/4 the cross-slice bytes (the reducer
+        # table's caveat applies: on one CPU core the codec only adds
+        # work; a real slice fills in the win).
+        for wire in ("bf16", "int8"):
+            hier_w = build(
+                hier_mesh, ("dcn", "ici"),
+                partial(hier_body, overlap=False, wire=wire),
+            )
+            over_w = build(
+                hier_mesh, ("dcn", "ici"),
+                partial(hier_body, overlap=True, wire=wire),
+            )
+            wrow = {
+                "axis_size": size,
+                "wire": wire,
+                "hierarchical_ms": round(time_fn(hier_w), 3),
+                "overlapped_ms": round(time_fn(over_w), 3),
+            }
+            wrow["hierarchical_speedup"] = round(
+                row["flat_ms"] / max(wrow["hierarchical_ms"], 1e-9), 3
+            )
+            wrow["overlapped_speedup"] = round(
+                row["flat_ms"] / max(wrow["overlapped_ms"], 1e-9), 3
+            )
+            rows.append(wrow)
+            log(f"S={size} wire={wire}: hierarchical "
+                f"{wrow['hierarchical_ms']}ms, overlapped "
+                f"{wrow['overlapped_ms']}ms")
+            print(json.dumps({"leg": wrow, "partial": True}),
+                  flush=True)
 
     out = {
         "moe_microbench": rows,

@@ -31,7 +31,7 @@ from distributed_model_parallel_tpu.analysis.rules import (
 
 _DTYPE_TOKEN = {
     "float32": "f32", "bfloat16": "bf16", "float16": "f16",
-    "float64": "f64",
+    "float64": "f64", "int8": "s8", "uint8": "u8",
 }
 
 
@@ -56,6 +56,11 @@ class Combo:
     moe_dispatch: str = "gspmd"
     moe_overlap: bool = False
 
+    # Cross-slice wire compression ("none" | "bf16" | "int8") — the
+    # `dcn_compression` knob on the reducer engines / the hierarchical
+    # MoE dispatch (`ops/wire_codec.py`, rule dcn-compressed-payload).
+    dcn_compression: str = "none"
+
     @property
     def name(self) -> str:
         bits = [self.engine, f"S{self.size}"]
@@ -67,6 +72,8 @@ class Combo:
             bits.append(self.moe_dispatch)
             if self.moe_overlap:
                 bits.append("ov")
+        if self.dcn_compression != "none":
+            bits.append(f"wire-{self.dcn_compression}")
         if self.model != "mlp":
             bits.append(self.model)
         if self.collective_matmul:
@@ -187,39 +194,55 @@ def _token(dtype) -> str:
     return _DTYPE_TOKEN.get(np.dtype(dtype).name, "f32")
 
 
-def _bucket_plan(leaves, bucket_mb: float, ici_size: int):
+def _bucket_plan(leaves, bucket_mb: float, pad_multiple: int):
     """[(padded_elems, dtype_token)] for one segment's gradient tree —
-    the shape the per-bucket collectives are pinned against."""
+    the shape the per-bucket collectives are pinned against.
+    `pad_multiple` comes from `grad_reduction.bucket_pad_multiple` (the
+    ici ring size, times the dcn factor on compressed combos)."""
     from distributed_model_parallel_tpu.ops.grad_reduction import (
         plan_buckets,
     )
 
     out = []
     for b in plan_buckets(leaves, bucket_mb):
-        padded = b.size + (-b.size % ici_size)
+        padded = b.size + (-b.size % pad_multiple)
         out.append((padded, _token(b.dtype)))
     return tuple(out)
 
 
 def _reducer_plans(model, grad_reduction: str, bucket_mb: float,
-                   ici_size: int, overlap_auto: int = 4):
+                   ici_size: int, dcn_size: int = 1,
+                   dcn_compression: str = "none"):
     """Per-segment bucket plans + segment count for a staged model —
     one segment for 'bucketed', split_points segments for
-    'overlapped'. Empty for 'monolithic'."""
+    'overlapped', one WHOLE-TREE bucket per dtype for compressed
+    'monolithic' (the engines' single-flat-bucket path). Empty for
+    uncompressed 'monolithic'."""
     import jax
     import jax.numpy as jnp
 
     from distributed_model_parallel_tpu.models import staging
+    from distributed_model_parallel_tpu.ops.grad_reduction import (
+        MONOLITHIC_BUCKET_MB,
+        bucket_pad_multiple,
+    )
 
+    pad_mult = bucket_pad_multiple(ici_size, dcn_size, dcn_compression)
     key_aval = jax.ShapeDtypeStruct((2,), jnp.uint32)
     p_aval, s_aval = jax.eval_shape(model.init, key_aval)
     state_shapes = tuple(
         tuple(leaf.shape)
         for leaf in jax.tree_util.tree_leaves(s_aval)
     )
+    if grad_reduction == "monolithic" and dcn_compression != "none":
+        plans = (_bucket_plan(
+            jax.tree_util.tree_leaves(p_aval), MONOLITHIC_BUCKET_MB,
+            pad_mult,
+        ),)
+        return plans, 0, state_shapes
     if grad_reduction == "bucketed":
         plans = (_bucket_plan(
-            jax.tree_util.tree_leaves(p_aval), bucket_mb, ici_size
+            jax.tree_util.tree_leaves(p_aval), bucket_mb, pad_mult
         ),)
         return plans, 0, state_shapes
     if grad_reduction == "overlapped":
@@ -229,12 +252,35 @@ def _reducer_plans(model, grad_reduction: str, bucket_mb: float,
         cuts = staging.split_points(n, None, len(model.parts.blocks))
         plans = tuple(
             _bucket_plan(
-                jax.tree_util.tree_leaves(sp), bucket_mb, ici_size
+                jax.tree_util.tree_leaves(sp), bucket_mb, pad_mult
             )
             for sp in staging.partition_tree(p_aval, cuts)
         )
         return plans, n, state_shapes
     return (), 0, state_shapes
+
+
+def _wire_chunk_expectations(plans, ici_size: int, dcn_size: int,
+                             dcn_compression: str):
+    """Expected (elems, wire_dtype_token) multiset of the compressed
+    'dcn' payload hops: each bucket's 1/ici shard re-chunks across the
+    K slices and crosses 2(K-1) times (exchange + gather,
+    `grad_reduction.compressed_dcn_psum`)."""
+    if dcn_compression == "none" or dcn_size <= 1:
+        return ()
+    from distributed_model_parallel_tpu.analysis.rules import (
+        DCN_WIRE_TOKEN,
+    )
+
+    # Every payload hop carries the WIRE dtype regardless of the
+    # bucket's math dtype (wire_encode casts unconditionally).
+    wire = DCN_WIRE_TOKEN[dcn_compression]
+    chunks = []
+    for plan in plans:
+        for padded, _dt in plan:
+            nl = padded // (ici_size * dcn_size)
+            chunks += [(nl, wire)] * (2 * (dcn_size - 1))
+    return tuple(chunks)
 
 
 def _n_param_leaves(ts) -> int:
@@ -245,14 +291,18 @@ def _n_param_leaves(ts) -> int:
     )
 
 
-def jaxpr_ppermute_dtypes(fn, *args):
-    """((axis_names, dtype_token, scope), ...) for every `ppermute`
-    equation in fn's jaxpr, sub-jaxprs included — the trace-level dtype
-    record the bf16 ring rule reads (compiled CPU HLO normalizes bf16
-    collectives to f32, so dtypes must come from the trace). `scope` is
-    the equation's name_stack string (named_scope names survive jvp and
-    transpose, e.g. 'transpose(jvp(kv_ring))'), which is how the rule
-    distinguishes the deliberately-f32 KV ring from the cm rings."""
+def jaxpr_ppermute_records(fn, *args):
+    """((axis_names, dtype_token, scope, n_elems), ...) for every
+    `ppermute` equation in fn's jaxpr, sub-jaxprs included — the
+    trace-level record the bf16-ring and compressed-wire rules read
+    (compiled CPU HLO normalizes bf16 collectives to f32, so dtype
+    contracts must come from the trace). `scope` is the equation's
+    name_stack string (named_scope names survive jvp and transpose,
+    e.g. 'transpose(jvp(kv_ring))'), which is how the rules
+    distinguish the deliberately-f32 KV ring from the cm rings and a
+    `dcn_wire` payload hop from its `dcn_scale` sidecar."""
+    import math as _math
+
     import jax
 
     closed = jax.make_jaxpr(fn)(*args)
@@ -267,11 +317,13 @@ def jaxpr_ppermute_dtypes(fn, *args):
             if eqn.primitive.name == "ppermute":
                 axes = eqn.params.get("axis_name")
                 axes = axes if isinstance(axes, tuple) else (axes,)
-                dt = str(eqn.invars[0].aval.dtype)
+                aval = eqn.invars[0].aval
+                dt = str(aval.dtype)
                 out.append((
                     tuple(str(a) for a in axes),
                     _DTYPE_TOKEN.get(dt, dt),
                     str(eqn.source_info.name_stack),
+                    int(_math.prod(aval.shape)) if aval.shape else 1,
                 ))
             for v in eqn.params.values():
                 for sub in _subjaxprs(v):
@@ -290,6 +342,13 @@ def jaxpr_ppermute_dtypes(fn, *args):
 
     walk(closed.jaxpr)
     return tuple(out)
+
+
+def jaxpr_ppermute_dtypes(fn, *args):
+    """The (axis_names, dtype_token, scope) cut of
+    `jaxpr_ppermute_records` — the record shape `LintTarget.ring_dtypes`
+    carries for the bf16-ring-upcast rule."""
+    return tuple(r[:3] for r in jaxpr_ppermute_records(fn, *args))
 
 
 def _mesh_facts(mesh):
@@ -348,7 +407,8 @@ def _build_data_engine(combo: Combo, devices):
 
         eng = DDPEngine(
             model, SGD(), mesh, grad_reduction=combo.grad_reduction,
-            bucket_mb=BUCKET_MB, **kwargs,
+            bucket_mb=BUCKET_MB,
+            dcn_compression=combo.dcn_compression, **kwargs,
         )
     else:  # fsdp
         from distributed_model_parallel_tpu.parallel.fsdp import (
@@ -362,7 +422,7 @@ def _build_data_engine(combo: Combo, devices):
         eng = FSDPEngine(
             model, SGD(), mesh, min_shard_elems=min_elems,
             grad_reduction=combo.grad_reduction, bucket_mb=BUCKET_MB,
-            **kwargs,
+            dcn_compression=combo.dcn_compression, **kwargs,
         )
         from jax.sharding import PartitionSpec as P
 
@@ -383,19 +443,31 @@ def _build_data_engine(combo: Combo, devices):
         full_leaf_shapes = tuple(shapes)
 
     plans, n_seg, state_shapes = _reducer_plans(
-        model, combo.grad_reduction, BUCKET_MB, facts["ici_size"]
+        model, combo.grad_reduction, BUCKET_MB, facts["ici_size"],
+        facts["dcn_size"], combo.dcn_compression,
     )
     ts = eng.init_state(jax.random.PRNGKey(0))
     im, lb = eng.shard_batch(*image_batch(16 * (s // 2 or 1)))
     hlo = eng.train_step.lower(
         ts, im, lb, jnp.float32(0.1)
     ).compile().as_text()
+    dcn_records = (
+        jaxpr_ppermute_records(eng.train_step, ts, im, lb,
+                               jnp.float32(0.1))
+        if combo.dcn_compression != "none" else ()
+    )
     target = LintTarget(
         name=combo.name, engine=combo.engine,
         grad_reduction=combo.grad_reduction, bf16=combo.bf16,
         donate=True, bucket_plans=plans, overlap_segments=n_seg,
         state_leaf_shapes=state_shapes,
         fsdp_full_leaf_shapes=full_leaf_shapes,
+        dcn_compression=combo.dcn_compression,
+        dcn_wire_chunks=_wire_chunk_expectations(
+            plans, facts["ici_size"], facts["dcn_size"],
+            combo.dcn_compression,
+        ),
+        dcn_ring_records=dcn_records,
         n_param_leaves=_n_param_leaves(ts), **facts,
     )
     return target, hlo, mesh
@@ -533,6 +605,7 @@ def _build_sp_lm(combo: Combo, devices):
         cfg, SGD(), mesh, donate=True,
         grad_reduction=combo.grad_reduction, bucket_mb=BUCKET_MB,
         collective_matmul=combo.collective_matmul,
+        dcn_compression=combo.dcn_compression,
     )
     ts = eng.init_state(jax.random.PRNGKey(0))
     rng = np.random.RandomState(0)
@@ -542,32 +615,19 @@ def _build_sp_lm(combo: Combo, devices):
         ts, ids, tg, jnp.float32(0.1)
     ).compile().as_text()
 
-    # Reducer expectations over the LM's stem/blocks/head params.
-    import jax as _jax
-
-    from distributed_model_parallel_tpu.models import staging
-
-    key_aval = _jax.ShapeDtypeStruct((2,), jnp.uint32)
-    p_aval, _ = _jax.eval_shape(gpt_lm(cfg).init, key_aval)
-    plans: Tuple = ()
-    n_seg = 0
-    if combo.grad_reduction == "bucketed":
-        plans = (_bucket_plan(
-            _jax.tree_util.tree_leaves(p_aval), BUCKET_MB,
-            facts["ici_size"],
-        ),)
-    elif combo.grad_reduction == "overlapped":
-        n_seg = staging.resolve_overlap_segments(
-            cfg.num_layers, 0, "lint"
-        )
-        cuts = staging.split_points(n_seg, None, cfg.num_layers)
-        plans = tuple(
-            _bucket_plan(
-                _jax.tree_util.tree_leaves(sp), BUCKET_MB,
-                facts["ici_size"],
-            )
-            for sp in staging.partition_tree(p_aval, cuts)
-        )
+    # Reducer expectations over the LM's stem/blocks/head params —
+    # gpt_lm builds through the staged substrate, so the shared
+    # expectation builder serves it like the image engines (one copy
+    # of the monolithic-compressed/bucketed/overlapped plan logic).
+    plans, n_seg, _ = _reducer_plans(
+        gpt_lm(cfg), combo.grad_reduction, BUCKET_MB,
+        facts["ici_size"], facts["dcn_size"], combo.dcn_compression,
+    )
+    dcn_records = (
+        jaxpr_ppermute_records(eng.train_step, ts, ids, tg,
+                               jnp.float32(0.1))
+        if combo.dcn_compression != "none" else ()
+    )
     target = LintTarget(
         name=combo.name, engine="sp_lm",
         grad_reduction=combo.grad_reduction, donate=True,
@@ -576,6 +636,12 @@ def _build_sp_lm(combo: Combo, devices):
         cm_size=seq,
         cm_min_ring_permutes=5 * (seq - 1) * cfg.num_layers,
         bucket_plans=plans, overlap_segments=n_seg,
+        dcn_compression=combo.dcn_compression,
+        dcn_wire_chunks=_wire_chunk_expectations(
+            plans, facts["ici_size"], facts["dcn_size"],
+            combo.dcn_compression,
+        ),
+        dcn_ring_records=dcn_records,
         n_param_leaves=_n_param_leaves(ts), **facts,
     )
     return target, hlo, mesh
@@ -613,6 +679,7 @@ def _build_ep(combo: Combo, devices):
         eng = ExpertParallelEngine(
             moe_classifier(s, dim=dim), SGD(), mesh, donate=True,
             dispatch="hierarchical", overlap=combo.moe_overlap,
+            dcn_compression=combo.dcn_compression,
         )
         facts = _mesh_facts(mesh)
         # One MoE layer, fwd exchange pair + mirrored backward.
@@ -638,10 +705,26 @@ def _build_ep(combo: Combo, devices):
     hlo = eng.train_step.lower(
         ts, xs, lbs, jnp.float32(0.1)
     ).compile().as_text()
+    # Compressed exchange: per routed layer the 'dcn' stage crosses
+    # 2(K-1) hops per direction pair (dispatch + combine, or the
+    # overlapped ring's in+out), doubled by the mirrored backward =
+    # 4(K-1) dcn_wire payload hops (one routed layer here). The chunk
+    # SHAPES are model-dependent, so the rule pins hop count + wire
+    # dtype (`dcn_wire_hops`) instead of a byte multiset.
+    wire_hops = None
+    dcn_records = ()
+    if combo.dcn_compression != "none":
+        wire_hops = 4 * (facts["dcn_size"] - 1)
+        dcn_records = jaxpr_ppermute_records(
+            eng.train_step, ts, xs, lbs, jnp.float32(0.1)
+        )
     target = LintTarget(
         name=combo.name, engine="ep", donate=True,
         moe_dispatch=combo.moe_dispatch,
         moe_ring_permutes=expected,
+        dcn_compression=combo.dcn_compression,
+        dcn_wire_hops=wire_hops,
+        dcn_ring_records=dcn_records,
         n_param_leaves=_n_param_leaves(ts), **facts,
     )
     return target, hlo, mesh
@@ -862,6 +945,33 @@ def full_matrix() -> List[Combo]:
         Combo("ep", 8, dcn=2, moe_dispatch="hierarchical",
               moe_overlap=True)
     )
+    # Quantized 'dcn' wire (PR 11, rule dcn-compressed-payload): the
+    # compressed cross-slice hop on every engine that exposes it —
+    # reducer modes x {bf16, int8} incl. the monolithic single-bucket
+    # path, the CausalLM-SP data buckets, and the hierarchical MoE
+    # dispatch (unfused + overlapped).
+    combos.append(Combo("ddp", 4, grad_reduction="bucketed", dcn=2,
+                        dcn_compression="bf16"))
+    combos.append(Combo("ddp", 8, grad_reduction="overlapped", dcn=2,
+                        dcn_compression="int8"))
+    combos.append(Combo("ddp", 4, grad_reduction="monolithic", dcn=2,
+                        dcn_compression="int8"))
+    combos.append(Combo("fsdp", 4, grad_reduction="bucketed", dcn=2,
+                        dcn_compression="bf16"))
+    combos.append(Combo("fsdp", 8, grad_reduction="overlapped", dcn=2,
+                        dcn_compression="int8"))
+    combos.append(Combo("fsdp", 8, grad_reduction="monolithic", dcn=2,
+                        dcn_compression="int8"))
+    combos.append(Combo("sp_lm", 4, grad_reduction="bucketed", dcn=2,
+                        dcn_compression="bf16"))
+    combos.append(Combo("sp_lm", 4, grad_reduction="overlapped",
+                        dcn=2, dcn_compression="int8"))
+    combos.append(Combo("ep", 4, dcn=2, moe_dispatch="hierarchical",
+                        dcn_compression="bf16"))
+    combos.append(
+        Combo("ep", 8, dcn=2, moe_dispatch="hierarchical",
+              moe_overlap=True, dcn_compression="int8")
+    )
     combos += pregate_matrix()
     return combos
 
@@ -871,12 +981,16 @@ def pregate_matrix() -> List[Combo]:
     overlapped — the deepest rule stack (rings + overlap deps + BN
     allowlist + at-rest) — plus one tinycnn-sized hierarchical MoE
     combo on a hybrid fabric, so a dispatch regression fails in seconds
-    with `moe-hierarchical-a2a` named."""
+    with `moe-hierarchical-a2a` named, and one tinycnn-sized quantized
+    hybrid combo so a broken wire codec fails with
+    `dcn-compressed-payload` named."""
     return [
         Combo("ddp", 8, grad_reduction="overlapped", model="tinycnn"),
         Combo("fsdp", 8, grad_reduction="overlapped", model="tinycnn"),
         Combo("ep", 4, dcn=2, moe_dispatch="hierarchical",
               moe_overlap=True),
+        Combo("ddp", 4, grad_reduction="bucketed", dcn=2,
+              dcn_compression="int8", model="tinycnn"),
     ]
 
 

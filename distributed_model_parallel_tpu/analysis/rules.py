@@ -113,6 +113,24 @@ class LintTarget:
     moe_dispatch: str = "gspmd"
     moe_ring_permutes: Optional[int] = None
 
+    # Compressed-'dcn'-wire expectations (`ops/wire_codec.py`, rule
+    # `dcn-compressed-payload`). `dcn_ring_records` is the traced-jaxpr
+    # record of EVERY ppermute equation — ((axis_names, dtype_token,
+    # scope, n_elems), ...) — because compiled CPU HLO float-normalizes
+    # bf16 collectives to f32 (the bf16-ring-upcast precedent), so the
+    # wire dtype/byte contract lives at trace level. One of the two
+    # expectations pins the payload hops: `dcn_wire_chunks` is the
+    # exact multiset of (n_elems, wire_dtype_token) per hop (the
+    # reducer paths, computable from the bucket plans), and
+    # `dcn_wire_hops` is the exact hop COUNT when per-hop shapes are
+    # model-dependent (the MoE exchange: 4(K-1) per routed layer).
+    dcn_compression: str = "none"
+    dcn_wire_chunks: Tuple[Tuple[int, str], ...] = ()
+    dcn_wire_hops: Optional[int] = None
+    dcn_ring_records: Tuple[
+        Tuple[Tuple[str, ...], str, str, int], ...
+    ] = ()
+
     # rule_id -> reason; the finding is reported but not counted
     # (module docstring).
     exemptions: Dict[str, str] = dataclasses.field(default_factory=dict)
@@ -215,8 +233,13 @@ def run_rules(ctx: LintContext) -> List[Finding]:
 
 
 def _is_reducer(t: LintTarget) -> bool:
+    # Compressed-monolithic counts too: dcn_compression on a
+    # "monolithic" step routes the reduction through ONE flat bucket
+    # per dtype (the engines' single-bucket path), so the bucket-ring
+    # and no-grad-all-reduce contracts apply to it unchanged.
     return (
-        t.grad_reduction in ("bucketed", "overlapped")
+        (t.grad_reduction in ("bucketed", "overlapped")
+         or t.dcn_compression != "none")
         and t.engine in ("ddp", "fsdp", "sp_lm")
     )
 
@@ -281,9 +304,12 @@ def _bucket_ring_permutes(ctx: LintContext) -> List[Finding]:
     contract=(
         "On a hybrid mesh, each bucket crosses 'dcn' exactly once, as "
         "an all-reduce shape-pinned at the bucket's 1/ici shard of its "
-        "padded flat buffer."
+        "padded flat buffer. (Compressed combos carry NO dcn "
+        "all-reduce at all — their hop contract is "
+        "dcn-compressed-payload's.)"
     ),
-    applies=lambda t: _is_reducer(t) and t.dcn_size > 1,
+    applies=lambda t: _is_reducer(t) and t.dcn_size > 1
+    and t.dcn_compression == "none",
 )
 def _dcn_bucket_psum_shard(ctx: LintContext) -> List[Finding]:
     t = ctx.target
@@ -693,6 +719,172 @@ def _moe_hierarchical_a2a(ctx: LintContext) -> List[Finding]:
                 f"{c.name}: {c.payload_bytes} B all-to-all touching the "
                 f"data fabric {tuple(t.data_axes)} — the flat token "
                 "exchange survived on an opted-in step",
+                c.name,
+            ))
+    return out
+
+
+# Wire-dtype tokens per compression mode (`ops/wire_codec.py`): the
+# dtype every payload hop of an opted-in step must carry — bf16 halves
+# the f32 bytes, int8 quarters them (+ one f32 scalar sidecar per hop).
+DCN_WIRE_TOKEN = {"bf16": "bf16", "int8": "s8"}
+
+
+def _scope_word(word: str, scope: str) -> bool:
+    import re as _re
+
+    return bool(_re.search(rf"\b{_re.escape(word)}\b", scope))
+
+
+@rule(
+    id="dcn-compressed-payload", severity="error", source="PR 11",
+    contract=(
+        "An opted-in compressed step keeps EVERY cross-'dcn' hop on "
+        "the wire codec: each traced dcn-crossing ppermute is either a "
+        "dcn_wire-scoped payload in the wire dtype (shape-pinned at "
+        "1/2 resp. 1/4 the f32 bytes — the regrouped chunk's element "
+        "count at the wire itemsize) or, under int8, its one-scalar "
+        "f32 dcn_scale sidecar; and ZERO f32 grad- or dispatch-sized "
+        "payload crosses 'dcn' in the compiled HLO (no non-scalar "
+        "all-reduce outside the BN-state allowlist, no all-to-all, no "
+        "all-gather/reduce-scatter). Checked from the traced jaxpr "
+        "like bf16-ring-upcast — the CPU backend float-normalizes "
+        "bf16 collectives in compiled HLO."
+    ),
+    applies=lambda t: t.dcn_compression != "none" and t.dcn_size > 1,
+)
+def _dcn_compressed_payload(ctx: LintContext) -> List[Finding]:
+    t = ctx.target
+    out: List[Finding] = []
+    wire = DCN_WIRE_TOKEN[t.dcn_compression]
+
+    if not t.dcn_ring_records:
+        out.append(ctx.finding(
+            "dcn-compressed-payload",
+            "no traced ppermute records collected for a compressed "
+            "combo — the wire dtype/byte contract was not checked",
+        ))
+        return out
+
+    payload: List[Tuple[int, str]] = []
+    sidecars: List[Tuple[str, int]] = []
+    for axes, dt, scope, elems in t.dcn_ring_records:
+        if t.dcn_axis not in axes:
+            continue  # intra-slice / other-fabric traffic
+        if _scope_word("dcn_wire", scope):
+            payload.append((elems, dt))
+        elif _scope_word("dcn_scale", scope):
+            sidecars.append((dt, elems))
+        else:
+            out.append(ctx.finding(
+                "dcn-compressed-payload",
+                f"uncoded ppermute crosses '{t.dcn_axis}' on an "
+                f"opted-in step ({elems} x {dt}, scope {scope!r}) — "
+                "traffic outside the wire codec",
+            ))
+
+    # Payload pin: exact multiset of (elems, wire dtype) when the
+    # builder can compute it (bucket plans), exact hop count otherwise.
+    if t.dcn_wire_chunks:
+        expected = Counter(t.dcn_wire_chunks)
+        actual = Counter(payload)
+        if actual != expected:
+            out.append(ctx.finding(
+                "dcn-compressed-payload",
+                f"dcn_wire payload hops {dict(actual)} != expected "
+                f"compressed chunks {dict(expected)} (elems x "
+                "wire-dtype per hop)",
+            ))
+    elif t.dcn_wire_hops is not None:
+        if len(payload) != t.dcn_wire_hops:
+            out.append(ctx.finding(
+                "dcn-compressed-payload",
+                f"{len(payload)} dcn_wire payload hops, expected "
+                f"exactly {t.dcn_wire_hops}",
+            ))
+        for elems, dt in payload:
+            if dt != wire:
+                out.append(ctx.finding(
+                    "dcn-compressed-payload",
+                    f"dcn_wire payload hop carries {dt} ({elems} "
+                    f"elems), expected the {wire} wire dtype",
+                ))
+    else:
+        out.append(ctx.finding(
+            "dcn-compressed-payload",
+            "no dcn_wire_chunks/dcn_wire_hops expectation on a "
+            "compressed combo — the payload pin was not checked",
+        ))
+
+    # Sidecar accounting: one f32 scalar per int8 payload hop, none
+    # otherwise.
+    if t.dcn_compression == "int8":
+        bad = [s for s in sidecars if s != ("f32", 1)]
+        for dt, elems in bad:
+            out.append(ctx.finding(
+                "dcn-compressed-payload",
+                f"dcn_scale sidecar is {elems} x {dt}, expected one "
+                "f32 scalar per hop",
+            ))
+        if not bad and len(sidecars) != len(payload):
+            out.append(ctx.finding(
+                "dcn-compressed-payload",
+                f"{len(sidecars)} dcn_scale sidecars for "
+                f"{len(payload)} int8 payload hops — expected one per "
+                "hop",
+            ))
+    elif sidecars:
+        out.append(ctx.finding(
+            "dcn-compressed-payload",
+            f"{len(sidecars)} dcn_scale sidecar(s) on a "
+            f"{t.dcn_compression} combo — the cast codec has no scale",
+        ))
+
+    # Compiled-HLO half: zero f32 grad-/dispatch-sized payload crosses
+    # 'dcn' in any monolithic form. On the reducer engines EVERY
+    # non-state dcn all-reduce / gather is contraband; the EP engine's
+    # gradient reduction legitimately stays on the partitioner's fused
+    # collectives (only the DISPATCH is compressed there), so for it
+    # only the token-sized all-to-all is forbidden — the shape the
+    # flat exchange would take across the slice boundary.
+    if t.engine in ("ddp", "fsdp", "sp_lm"):
+        allowed_state = set(t.state_leaf_shapes)
+        for c in nonscalar_all_reduces(ctx.collectives):
+            if not c.crosses(t.dcn_axis):
+                continue
+            if all(
+                b.shape in allowed_state
+                for b in c.instruction.buffers
+            ):
+                continue  # BN running-stat / batch-stat psum
+            out.append(ctx.finding(
+                "dcn-compressed-payload",
+                f"{c.name}: {c.payload_bytes} B all-reduce crosses "
+                f"'{t.dcn_axis}' on a compressed step — uncompressed "
+                "payload on the slow fabric",
+                c.name,
+            ))
+        # FSDP's per-leaf WEIGHT all-gathers legitimately cross 'dcn'
+        # (params live 1/N over the joint fabric — fetching them is not
+        # gradient traffic), so the gather ban covers the
+        # replicated-param engines only.
+        if t.engine in ("ddp", "sp_lm"):
+            for c in ctx.collectives:
+                if c.kind in ("all-gather", "reduce-scatter") \
+                        and c.crosses(t.dcn_axis):
+                    out.append(ctx.finding(
+                        "dcn-compressed-payload",
+                        f"{c.name}: monolithic {c.kind} crosses "
+                        f"'{t.dcn_axis}' on a compressed step",
+                        c.name,
+                    ))
+    for c in ctx.collectives:
+        if c.kind == "all-to-all" and c.crosses(t.dcn_axis):
+            out.append(ctx.finding(
+                "dcn-compressed-payload",
+                f"{c.name}: {c.payload_bytes} B all-to-all crosses "
+                f"'{t.dcn_axis}' on a compressed step — the flat "
+                "dispatch payload on the slow fabric",
                 c.name,
             ))
     return out

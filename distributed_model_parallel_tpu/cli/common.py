@@ -370,6 +370,20 @@ def add_grad_reduction_flags(parser: argparse.ArgumentParser) -> None:
              "fire as soon as its backward completes (default: "
              "min(4, model blocks))",
     )
+    parser.add_argument(
+        "--dcn-compression", default="none",
+        choices=("none", "bf16", "int8"),
+        help="compress the cross-slice 'dcn' hop of every explicit "
+             "exchange — the bucket reduction's per-slice shard "
+             "messages and (on the lm CLI) the hierarchical MoE "
+             "dispatch's regrouped messages — to this wire dtype "
+             "(ops/wire_codec.py: bf16 = cast codec, 1/2 the dcn "
+             "bytes; int8 = absmax-scale codec + f32 scale sidecar, "
+             "1/4 the bytes; int8 never sums in int8 — chunks decode "
+             "before accumulating). Master weights, intra-slice rings "
+             "and all math stay full precision; requires --dcn-slices "
+             ">= 2 (the compressed hop IS the slice boundary)",
+    )
 
 
 def check_grad_reduction_args(args) -> None:
@@ -405,6 +419,12 @@ def check_grad_reduction_args(args) -> None:
     if args.dcn_slices < 1:
         raise SystemExit(
             f"--dcn-slices must be >= 1, got {args.dcn_slices}"
+        )
+    if args.dcn_compression != "none" and args.dcn_slices < 2:
+        raise SystemExit(
+            "--dcn-compression compresses the cross-slice 'dcn' hop, "
+            "and this run has no 'dcn' axis to cross — factor the data "
+            "axis with --dcn-slices >= 2 (or drop --dcn-compression)"
         )
 
 
@@ -510,6 +530,12 @@ def check_serving_args(args) -> None:
         raise SystemExit(
             "--dcn-slices factors the data axis for gradient traffic; "
             "the serving meshes are 'model'/'seq' only — drop the flag"
+        )
+    if args.dcn_compression != "none":
+        raise SystemExit(
+            "--dcn-compression compresses the training engines' "
+            "cross-slice gradient/dispatch hop; the serving meshes "
+            "have no 'dcn' fabric — drop the flag"
         )
     if args.layout == "tp":
         if args.model_shards < 2:

@@ -147,6 +147,16 @@ def main(argv=None) -> dict:
             f"(ddp, fsdp); the declarative --engine {args.engine} step "
             "has no explicit reduction site to bucket or overlap"
         )
+    if args.dcn_compression != "none" and args.engine not in (
+        "ddp", "fsdp"
+    ):
+        raise SystemExit(
+            "--dcn-compression compresses the explicit cross-slice "
+            "gradient hop of the shard_map engines (ddp, fsdp); the "
+            f"declarative --engine {args.engine} step has no explicit "
+            "'dcn' hop to compress — switch to --engine ddp/fsdp or "
+            "drop the flag"
+        )
     if args.grad_reduction == "overlapped":
         from distributed_model_parallel_tpu.cli.common import (
             check_overlapped_model,
@@ -248,6 +258,7 @@ def main(argv=None) -> dict:
             grad_reduction=args.grad_reduction,
             bucket_mb=args.bucket_mb,
             overlap_stages=args.overlap_stages,
+            dcn_compression=args.dcn_compression,
         )
     elif args.engine == "fsdp":
         from distributed_model_parallel_tpu.parallel.fsdp import FSDPEngine
@@ -257,6 +268,7 @@ def main(argv=None) -> dict:
             grad_reduction=args.grad_reduction,
             bucket_mb=args.bucket_mb,
             overlap_stages=args.overlap_stages,
+            dcn_compression=args.dcn_compression,
         )
     elif args.engine == "tp":
         from distributed_model_parallel_tpu.parallel.tensor_parallel import (

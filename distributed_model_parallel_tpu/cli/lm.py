@@ -258,6 +258,16 @@ def main(argv=None) -> dict:
                 "--moe-overlap chunks the hierarchical exchange; set "
                 "--moe-dispatch hierarchical"
             )
+        if (
+            args.dcn_compression != "none"
+            and args.moe_dispatch != "hierarchical"
+        ):
+            raise SystemExit(
+                "--dcn-compression compresses the hierarchical "
+                "exchange's cross-slice messages; the gspmd dispatch "
+                "has no explicit 'dcn' hop — set --moe-dispatch "
+                "hierarchical (with --dcn-slices >= 2) or drop the flag"
+            )
         if args.moe_dispatch == "hierarchical" and args.expert_shards != 1:
             raise SystemExit(
                 "--moe-dispatch hierarchical shards experts over the "
@@ -267,13 +277,16 @@ def main(argv=None) -> dict:
     check_grad_reduction_args(args)
     check_checkpoint_args(args)
     if args.pipeline_stages > 1 and (
-        args.grad_reduction != "monolithic" or args.dcn_slices != 1
+        args.grad_reduction != "monolithic"
+        or args.dcn_slices != 1
+        or args.dcn_compression != "none"
     ):
         raise SystemExit(
-            "--grad-reduction bucketed/overlapped / --dcn-slices "
-            "address the sequence-parallel engine's data-axis gradient "
-            "collective; the pipeline engine reduces over 'stage' "
-            "wires — drop the flags or --pipeline-stages"
+            "--grad-reduction bucketed/overlapped / --dcn-slices / "
+            "--dcn-compression address the sequence-parallel engine's "
+            "data-axis gradient collective; the pipeline engine "
+            "reduces over 'stage' wires — drop the flags or "
+            "--pipeline-stages"
         )
     if args.grad_reduction == "overlapped":
         if args.layers < 2:
@@ -375,6 +388,7 @@ def main(argv=None) -> dict:
             mesh,
             dispatch=args.moe_dispatch,
             overlap=args.moe_overlap,
+            dcn_compression=args.dcn_compression,
             pad_token_id=cfg.pad_token_id,
             compute_dtype=compute_dtype_from_flag(args.dtype),
         )
@@ -387,6 +401,7 @@ def main(argv=None) -> dict:
             grad_reduction=args.grad_reduction,
             bucket_mb=args.bucket_mb,
             overlap_stages=args.overlap_stages,
+            dcn_compression=args.dcn_compression,
         )
     corpus = synthetic_corpus(
         args.vocab_size, args.corpus_tokens, seed=args.corpus_seed

@@ -96,6 +96,10 @@ from jax.sharding import Mesh, PartitionSpec as P
 
 from distributed_model_parallel_tpu.models.moe import expert_ffn
 from distributed_model_parallel_tpu.ops.collective_matmul import _axis_size
+from distributed_model_parallel_tpu.ops.wire_codec import (
+    coded_ppermute,
+    require_dcn_axis,
+)
 from distributed_model_parallel_tpu.runtime.compat import shard_map
 
 # The named scope every exchange hop carries; hlolint's
@@ -108,6 +112,23 @@ SCOPE = "moe_ring"
 def _tagged_ppermute(x, axis_name, perm):
     with jax.named_scope(SCOPE):
         return lax.ppermute(x, axis_name, perm)
+
+
+def _wire_ppermute(x, axis_name, perm, wire):
+    """One cross-slice hop, payload in the wire dtype when the step
+    opted into `dcn_compression` (`ops/wire_codec.coded_ppermute`): the
+    chunk is encoded, permuted under the nested `moe_ring`/`dcn_wire`
+    scopes (so BOTH the exchange-chain pin and the byte-aware wire pin
+    see it), and decoded on arrival; the int8 scale sidecar rides the
+    same permutation under its own `dcn_scale` scope, outside the
+    moe_ring count. With `wire="none"` this is `_tagged_ppermute`."""
+    if wire == "none":
+        return _tagged_ppermute(x, axis_name, perm)
+    return coded_ppermute(x, axis_name, tuple(perm), wire, tag=SCOPE)
+
+
+def _check_dcn_wire(wire: str, dcn_axis) -> str:
+    return require_dcn_axis(wire, dcn_axis, what="MoE exchange")
 
 
 def _fabric_size(ici_axis, dcn_axis) -> int:
@@ -135,10 +156,13 @@ def _check_experts(e: int, s: int) -> int:
 # exact mirror of the dispatch path.
 
 
-def _a2a_chunks(x, axis_name):
+def _a2a_chunks(x, axis_name, wire: str = "none"):
     """(G, ...) dest-indexed -> (G, ...) source-indexed over `axis_name`
     (G = axis size), as G-1 `moe_ring`-scoped ppermutes — hop r moves
-    every device's chunk for the destination r steps around."""
+    every device's chunk for the destination r steps around. `wire`
+    compresses each hop's payload (`ops/wire_codec.py`); the engines
+    set it only on the 'dcn' stage — the intra-slice stage always rides
+    the math dtype."""
     size = _axis_size(axis_name)
     if x.shape[0] != size:
         raise ValueError(
@@ -156,7 +180,7 @@ def _a2a_chunks(x, axis_name):
     out = lax.dynamic_update_slice_in_dim(out, chunk(i), i, axis=0)
     for r in range(1, size):
         perm = [(j, (j + r) % size) for j in range(size)]
-        recv = _tagged_ppermute(chunk(i + r), axis_name, perm)
+        recv = _wire_ppermute(chunk(i + r), axis_name, perm, wire)
         out = lax.dynamic_update_slice_in_dim(
             out, recv, (i - r) % size, axis=0
         )
@@ -166,10 +190,11 @@ def _a2a_chunks(x, axis_name):
 # --------------------------------------------- two-level movement ops
 
 
-def _dispatch_impl(xin, ici_axis, dcn_axis):
+def _dispatch_impl(xin, ici_axis, dcn_axis, wire="none"):
     """(E, b, C, D) dest-expert-major local buffer -> (E/S, S*b, C, D):
     this device's expert block's inputs from EVERY source, source order
-    = linear fabric index ('dcn'-major, matching the batch sharding)."""
+    = linear fabric index ('dcn'-major, matching the batch sharding).
+    `wire` compresses ONLY the cross-slice stage's payload."""
     n_i = _axis_size(ici_axis)
     n_k = _axis_size(dcn_axis) if dcn_axis is not None else 1
     e, b, c, d = xin.shape
@@ -181,14 +206,15 @@ def _dispatch_impl(xin, ici_axis, dcn_axis):
     x = _a2a_chunks(x, ici_axis)       # (I_src,  K_dest, el, b, c, d)
     x = jnp.swapaxes(x, 0, 1)          # (K_dest, I_src,  el, b, c, d)
     # Stage 2 — cross-slice: ONE exchange over 'dcn' on the regrouped
-    # buffer (each chunk already carries the 1/ici expert shard).
+    # buffer (each chunk already carries the 1/ici expert shard) — the
+    # only stage the wire codec touches.
     if dcn_axis is not None:
-        x = _a2a_chunks(x, dcn_axis)   # (K_src,  I_src,  el, b, c, d)
+        x = _a2a_chunks(x, dcn_axis, wire)  # (K_src, I_src, el, b, c, d)
     x = jnp.moveaxis(x, 2, 0)          # (el, K_src, I_src, b, c, d)
     return x.reshape(el, s * b, c, d)
 
 
-def _combine_impl(y, ici_axis, dcn_axis):
+def _combine_impl(y, ici_axis, dcn_axis, wire="none"):
     """Inverse of `_dispatch_impl`: (E/S, S*b, C, D) expert outputs back
     to (E, b, C, D) dest-expert-major at each token's home shard."""
     n_i = _axis_size(ici_axis)
@@ -205,45 +231,46 @@ def _combine_impl(y, ici_axis, dcn_axis):
     if dcn_axis is not None:
         # The pairwise exchange is an involution: applying it again
         # returns every chunk to its origin.
-        x = _a2a_chunks(x, dcn_axis)   # (K_dest, I_src, el, b, c, d)
+        x = _a2a_chunks(x, dcn_axis, wire)  # (K_dest, I_src, el, b, c, d)
     x = jnp.swapaxes(x, 0, 1)          # (I_src, K_dest, el, b, c, d)
     x = _a2a_chunks(x, ici_axis)       # (I_dest, K_dest, el, b, c, d)
     x = jnp.swapaxes(x, 0, 1)          # (K, I, el, b, c, d)
     return x.reshape(el * s, b, c, d)
 
 
-@partial(jax.custom_vjp, nondiff_argnums=(1, 2))
-def dispatch_exchange(xin, ici_axis, dcn_axis):
+@partial(jax.custom_vjp, nondiff_argnums=(1, 2, 3))
+def dispatch_exchange(xin, ici_axis, dcn_axis, wire="none"):
     """Two-level token dispatch: (E, b, C, D) -> (E/S, S*b, C, D).
-    Backward runs the mirrored combine-direction movement (custom_vjp),
-    so no flat collective appears in either direction."""
-    return _dispatch_impl(xin, ici_axis, dcn_axis)
+    Backward runs the mirrored combine-direction movement (custom_vjp)
+    over the SAME wire dtype, so no flat collective — and no silent
+    f32 fallback — appears in either direction."""
+    return _dispatch_impl(xin, ici_axis, dcn_axis, wire)
 
 
-def _dispatch_fwd(xin, ici_axis, dcn_axis):
-    return _dispatch_impl(xin, ici_axis, dcn_axis), None
+def _dispatch_fwd(xin, ici_axis, dcn_axis, wire):
+    return _dispatch_impl(xin, ici_axis, dcn_axis, wire), None
 
 
-def _dispatch_bwd(ici_axis, dcn_axis, _, dy):
-    return (_combine_impl(dy, ici_axis, dcn_axis),)
+def _dispatch_bwd(ici_axis, dcn_axis, wire, _, dy):
+    return (_combine_impl(dy, ici_axis, dcn_axis, wire),)
 
 
 dispatch_exchange.defvjp(_dispatch_fwd, _dispatch_bwd)
 
 
-@partial(jax.custom_vjp, nondiff_argnums=(1, 2))
-def combine_exchange(y, ici_axis, dcn_axis):
+@partial(jax.custom_vjp, nondiff_argnums=(1, 2, 3))
+def combine_exchange(y, ici_axis, dcn_axis, wire="none"):
     """Two-level expert-output return: (E/S, S*b, C, D) -> (E, b, C, D).
     Backward runs the mirrored dispatch-direction movement."""
-    return _combine_impl(y, ici_axis, dcn_axis)
+    return _combine_impl(y, ici_axis, dcn_axis, wire)
 
 
-def _combine_fwd(y, ici_axis, dcn_axis):
-    return _combine_impl(y, ici_axis, dcn_axis), None
+def _combine_fwd(y, ici_axis, dcn_axis, wire):
+    return _combine_impl(y, ici_axis, dcn_axis, wire), None
 
 
-def _combine_bwd(ici_axis, dcn_axis, _, dy):
-    return (_dispatch_impl(dy, ici_axis, dcn_axis),)
+def _combine_bwd(ici_axis, dcn_axis, wire, _, dy):
+    return (_dispatch_impl(dy, ici_axis, dcn_axis, wire),)
 
 
 combine_exchange.defvjp(_combine_fwd, _combine_bwd)
@@ -283,13 +310,14 @@ def _chunk_ffn(ffn, ch):
     return jnp.moveaxis(y, 0, 1)[None]
 
 
-def _ffn_ring(z, ffn, axis_name):
+def _ffn_ring(z, ffn, axis_name, wire="none"):
     """The latency-hiding loop: z (G, ...) dest-indexed chunks; each hop
     r delivers the chunk from source i-r, whose FFN fires while the
     hop-(r+1) permute and the hop-r return permute are in flight (the
     dots depend on neither — the same argument as `_ring_fold`).
     Returns (G, ...) with slot g holding the FFN output of this shard's
-    chunk g, back home."""
+    chunk g, back home. `wire` compresses BOTH directions of each hop
+    (the engines set it only when the ring runs over 'dcn')."""
     size = _axis_size(axis_name)
     i = lax.axis_index(axis_name)
 
@@ -303,16 +331,16 @@ def _ffn_ring(z, ffn, axis_name):
     for r in range(1, size):
         fwd = [(j, (j + r) % size) for j in range(size)]
         bwd = [(j, (j - r) % size) for j in range(size)]
-        recv = _tagged_ppermute(chunk(i + r), axis_name, fwd)
+        recv = _wire_ppermute(chunk(i + r), axis_name, fwd, wire)
         y_r = _chunk_ffn(ffn, recv)
-        back = _tagged_ppermute(y_r, axis_name, bwd)
+        back = _wire_ppermute(y_r, axis_name, bwd, wire)
         out = lax.dynamic_update_slice_in_dim(
             out, back, (i + r) % size, axis=0
         )
     return out
 
 
-def overlapped_expert_ffn(xin, ffn, ici_axis, dcn_axis):
+def overlapped_expert_ffn(xin, ffn, ici_axis, dcn_axis, wire="none"):
     """Fused exchange + expert FFN + return with chunked overlap:
     expert compute on chunk k overlaps communication of chunk k+1.
 
@@ -335,24 +363,27 @@ def overlapped_expert_ffn(xin, ffn, ici_axis, dcn_axis):
     x = jnp.swapaxes(x, 0, 1)          # (I_dest, K_dest, el, b, c, d)
     x = _a2a_chunks(x, ici_axis)       # (I_src,  K_dest, el, b, c, d)
     z = jnp.swapaxes(x, 0, 1)          # (K_dest, I_src,  el, b, c, d)
-    out = _ffn_ring(z, ffn, dcn_axis)  # (K_dest, I_src,  el, b, c, d)
+    out = _ffn_ring(z, ffn, dcn_axis, wire)  # (K_dest, I_src, el, ...)
     out = jnp.swapaxes(out, 0, 1)      # (I_src,  K_dest, el, b, c, d)
     out = _a2a_chunks(out, ici_axis)   # (I_dest, K_dest, el, b, c, d)
     out = jnp.swapaxes(out, 0, 1)      # (K, I, el, b, c, d)
     return out.reshape(e, b, c, d)
 
 
-def exchanged_expert_ffn(xin, ffn, ici_axis, dcn_axis, overlap):
+def exchanged_expert_ffn(xin, ffn, ici_axis, dcn_axis, overlap,
+                         wire="none"):
     """One MoE layer's exchange+FFN+return on local buffers: the
     unfused two-level path (dispatch -> one big FFN -> combine) or the
     chunked overlapped kernel. Both carry exactly
     2(I-1) + 2(K-1) `moe_ring` permutes forward (and the same again in
-    the transposed backward)."""
+    the transposed backward) whatever the wire dtype — compression
+    changes the payload bytes of the 'dcn' hops, never the hop
+    structure."""
     if overlap:
-        return overlapped_expert_ffn(xin, ffn, ici_axis, dcn_axis)
-    z = dispatch_exchange(xin, ici_axis, dcn_axis)
+        return overlapped_expert_ffn(xin, ffn, ici_axis, dcn_axis, wire)
+    z = dispatch_exchange(xin, ici_axis, dcn_axis, wire)
     y = ffn(z)
-    return combine_exchange(y, ici_axis, dcn_axis)
+    return combine_exchange(y, ici_axis, dcn_axis, wire)
 
 
 def exchange_permutes(ici_size: int, dcn_size: int = 1) -> int:
@@ -366,13 +397,14 @@ def exchange_permutes(ici_size: int, dcn_size: int = 1) -> int:
 # ------------------------------------------------------------ policies
 
 
-def _moe_local(h, dispatch, combine, w, *, ici_axis, dcn_axis, overlap):
+def _moe_local(h, dispatch, combine, w, *, ici_axis, dcn_axis, overlap,
+               wire="none"):
     """Per-shard MoE FFN around the exchange: local one-hot pack, the
     two-level (optionally overlapped) exchange+FFN, local weighted
     unpack. `w` leaves are this shard's E/S expert block."""
     xin = jnp.einsum("btec,btd->ebcd", dispatch, h)
     ffn = partial(expert_ffn, w, dtype=h.dtype)
-    y = exchanged_expert_ffn(xin, ffn, ici_axis, dcn_axis, overlap)
+    y = exchanged_expert_ffn(xin, ffn, ici_axis, dcn_axis, overlap, wire)
     return jnp.einsum("btec,ebcd->btd", combine, y)
 
 
@@ -388,6 +420,10 @@ class ExpertDispatch:
 
     mesh: Mesh
     overlap: bool = False
+    # Compress the cross-slice hops of the exchange to this wire dtype
+    # ("none" | "bf16" | "int8", `ops/wire_codec.py`); requires the
+    # mesh to carry a 'dcn' factor.
+    dcn_compression: str = "none"
 
     def __call__(self, h, dispatch, combine, w):
         from distributed_model_parallel_tpu.runtime.mesh import (
@@ -395,6 +431,7 @@ class ExpertDispatch:
         )
 
         d_axes, ici_axis, dcn_axis = data_hierarchy_axes(self.mesh)
+        _check_dcn_wire(self.dcn_compression, dcn_axis)
         s = int(math.prod(self.mesh.shape[a] for a in d_axes))
         _check_experts(w["w_in"].shape[0], s)
         if h.shape[0] % s:
@@ -412,7 +449,7 @@ class ExpertDispatch:
         fn = shard_map(
             partial(
                 _moe_local, ici_axis=ici_axis, dcn_axis=dcn_axis,
-                overlap=self.overlap,
+                overlap=self.overlap, wire=self.dcn_compression,
             ),
             mesh=self.mesh,
             in_specs=(
@@ -444,8 +481,11 @@ class LocalExpertDispatch:
     ici_axis: str
     dcn_axis: Optional[str] = None
     overlap: bool = False
+    # Cross-slice wire dtype (see ExpertDispatch.dcn_compression).
+    dcn_compression: str = "none"
 
     def __call__(self, h, dispatch, combine, w):
+        _check_dcn_wire(self.dcn_compression, self.dcn_axis)
         s = _fabric_size(self.ici_axis, self.dcn_axis)
         el = _check_experts(w["w_in"].shape[0], s)
         idx = lax.axis_index(self.ici_axis)
@@ -464,7 +504,7 @@ class LocalExpertDispatch:
         return _moe_local(
             h, dispatch, combine, w_loc,
             ici_axis=self.ici_axis, dcn_axis=self.dcn_axis,
-            overlap=self.overlap,
+            overlap=self.overlap, wire=self.dcn_compression,
         )
 
 

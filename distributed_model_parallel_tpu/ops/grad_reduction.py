@@ -70,6 +70,31 @@ from distributed_model_parallel_tpu.ops.collective_matmul import (
     _ring_fold,
     _split,
 )
+from distributed_model_parallel_tpu.ops.wire_codec import (
+    coded_ppermute,
+    require_dcn_axis,
+)
+
+# The "one flat bucket per dtype" cap: `bucketed_psum` with this
+# bucket_mb lowers the whole pytree through a single bucket — the shape
+# the engines use for grad_reduction="monolithic" + dcn_compression
+# (the monolithic lowering has no explicit dcn site to compress, so it
+# borrows the bucket machinery without the bucket SPLITTING).
+MONOLITHIC_BUCKET_MB = math.inf
+
+
+def bucket_pad_multiple(
+    ici_size: int, dcn_size: int, dcn_compression: str = "none"
+) -> int:
+    """Element multiple a bucket's flat buffer is zero-padded to. The
+    uncompressed path needs divisibility by the 'ici' ring alone (the
+    cross-slice psum takes the shard whole); the compressed path
+    re-chunks the 1/ici shard across the K 'dcn' peers, so the buffer
+    must also divide by K. Shared with `analysis/lint.py`'s expectation
+    builder so the pin and the runtime can never desynchronize."""
+    if dcn_compression != "none" and dcn_size > 1:
+        return ici_size * dcn_size
+    return ici_size
 
 
 @dataclasses.dataclass(frozen=True)
@@ -204,15 +229,74 @@ def ring_all_gather(x, axis_name):
 # ------------------------------------------------- bucketed reduction
 
 
-def reduce_bucket_flat(flat, ici_axis, dcn_axis=None):
+def compressed_dcn_psum(shard, dcn_axis, wire: str):
+    """All-reduce a 1/ici bucket shard across the K 'dcn' slices with
+    the PAYLOAD compressed (`ops/wire_codec.py`) — and the accumulate
+    NOT: int8 never sums in int8. The monolithic `lax.psum` is replaced
+    by its reduce-scatter/all-gather decomposition in the wire dtype:
+
+        exchange  K-1 `coded_ppermute` hops deliver every peer's
+                  encoded copy of THIS slice's 1/K sub-chunk; each is
+                  decoded on arrival and summed in the bucket dtype
+        gather    the reduced sub-chunk re-encodes once and rides K-1
+                  more hops back out to every peer
+
+    Total 'dcn' bytes: 2(K-1)/K of the shard at the wire itemsize
+    (plus one f32 scale sidecar per int8 hop) — the same 2(K-1)/K
+    volume the fused psum moves, at 1/2 resp. 1/4 the bytes. Error per
+    element: one codec rounding per received chunk plus one on the
+    gather re-encode, <= (K+1)·absmax/254 for int8 (INTERNALS §12).
+    The shard length must divide by K (`bucket_pad_multiple`)."""
+    k = _axis_size(dcn_axis)
+    if k == 1:
+        return shard
+    n = shard.shape[0]
+    if n % k:
+        raise ValueError(
+            f"compressed_dcn_psum: shard length {n} not divisible by "
+            f"axis {dcn_axis!r} size {k} (pad the bucket to "
+            "bucket_pad_multiple elements)"
+        )
+    nl = n // k
+    i = lax.axis_index(dcn_axis)
+
+    def chunk(c):
+        return lax.dynamic_slice_in_dim(shard, (c % k) * nl, nl, axis=0)
+
+    # Exchange: hop r moves every device's encoded chunk for the peer
+    # r steps around; decode + accumulate in the bucket dtype.
+    acc = chunk(i)
+    for r in range(1, k):
+        perm = tuple((j, (j + r) % k) for j in range(k))
+        acc = acc + coded_ppermute(chunk(i + r), dcn_axis, perm, wire)
+    # Gather: the reduced sub-chunk back out, one fresh encode per hop
+    # (re-forwarding a decoded copy would re-quantize hop by hop and
+    # compound the error with the ring distance).
+    out = jnp.zeros_like(shard)
+    out = lax.dynamic_update_slice_in_dim(out, acc, i * nl, axis=0)
+    for r in range(1, k):
+        perm = tuple((j, (j + r) % k) for j in range(k))
+        recv = coded_ppermute(acc, dcn_axis, perm, wire)
+        out = lax.dynamic_update_slice_in_dim(
+            out, recv, ((i - r) % k) * nl, axis=0
+        )
+    return out
+
+
+def reduce_bucket_flat(flat, ici_axis, dcn_axis=None,
+                       dcn_compression: str = "none"):
     """Hierarchically all-reduce one flat bucket buffer (already padded
-    to the 'ici' ring size): ring reduce-scatter over the intra-slice
-    fabric, one cross-slice all-reduce on the 1/S shard, ring
-    all-gather back out. With `dcn_axis=None` the same rings run over
-    the single fabric."""
+    to `bucket_pad_multiple` elements): ring reduce-scatter over the
+    intra-slice fabric, one cross-slice all-reduce on the 1/S shard —
+    compressed to the wire dtype when `dcn_compression` says so
+    (`compressed_dcn_psum`) — ring all-gather back out. With
+    `dcn_axis=None` the same rings run over the single fabric."""
     shard = ring_reduce_scatter(flat, ici_axis)
     if dcn_axis is not None:
-        shard = lax.psum(shard, dcn_axis)
+        if dcn_compression != "none":
+            shard = compressed_dcn_psum(shard, dcn_axis, dcn_compression)
+        else:
+            shard = lax.psum(shard, dcn_axis)
     return ring_all_gather(shard, ici_axis)
 
 
@@ -223,12 +307,19 @@ def bucketed_psum(
     *,
     bucket_mb: float = 25.0,
     mean: bool = False,
+    dcn_compression: str = "none",
 ):
     """Sum (or mean) a gradient pytree over the data fabric(s) through
     dtype-grouped flat-buffer buckets, each reduced hierarchically
     (`reduce_bucket_flat`). Must run inside `shard_map` with `ici_axis`
     (and `dcn_axis`, when given) bound. Numerically equal to
-    `lax.psum(grads, axes)` up to reduction order."""
+    `lax.psum(grads, axes)` up to reduction order — exactly with
+    `dcn_compression="none"`, within the documented codec budget (bf16
+    one-rounding-per-hop / int8 per-bucket absmax bound, module
+    docstring of `ops/wire_codec.py`) when the cross-slice hop is
+    compressed. Compression touches ONLY the 'dcn' wire: the intra-
+    slice rings and the accumulate stay in the bucket dtype."""
+    require_dcn_axis(dcn_compression, dcn_axis)
     leaves, treedef = jax.tree_util.tree_flatten(grads)
     if not leaves:
         return grads
@@ -236,17 +327,24 @@ def bucketed_psum(
         _axis_size(dcn_axis) if dcn_axis is not None else 1
     )
     ici_size = _axis_size(ici_axis)
+    pad_mult = bucket_pad_multiple(
+        ici_size,
+        _axis_size(dcn_axis) if dcn_axis is not None else 1,
+        dcn_compression,
+    )
     out: list = [None] * len(leaves)
     for bucket in plan_buckets(leaves, bucket_mb):
         flat = jnp.concatenate(
             [leaves[s.index].reshape(-1) for s in bucket.slots]
         )
-        pad = -flat.shape[0] % ici_size
+        pad = -flat.shape[0] % pad_mult
         if pad:
             flat = jnp.concatenate(
                 [flat, jnp.zeros((pad,), flat.dtype)]
             )
-        reduced = reduce_bucket_flat(flat, ici_axis, dcn_axis)
+        reduced = reduce_bucket_flat(
+            flat, ici_axis, dcn_axis, dcn_compression
+        )
         if mean:
             reduced = reduced * (1.0 / denom)
         for s in bucket.slots:
@@ -263,11 +361,13 @@ def bucketed_pmean(
     dcn_axis: Optional[str] = None,
     *,
     bucket_mb: float = 25.0,
+    dcn_compression: str = "none",
 ):
     """`lax.pmean` of a gradient pytree, bucketed and hierarchy-aware —
     the drop-in for `DDPEngine`'s monolithic grad pmean."""
     return bucketed_psum(
-        grads, ici_axis, dcn_axis, bucket_mb=bucket_mb, mean=True
+        grads, ici_axis, dcn_axis, bucket_mb=bucket_mb, mean=True,
+        dcn_compression=dcn_compression,
     )
 
 
@@ -284,8 +384,11 @@ def data_replica_index(axes: Sequence[str]):
 __all__ = [
     "Bucket",
     "BucketSlot",
+    "MONOLITHIC_BUCKET_MB",
+    "bucket_pad_multiple",
     "bucketed_pmean",
     "bucketed_psum",
+    "compressed_dcn_psum",
     "data_replica_index",
     "plan_buckets",
     "reduce_bucket_flat",

@@ -58,8 +58,13 @@ from distributed_model_parallel_tpu.runtime.compat import shard_map
 from distributed_model_parallel_tpu.models import staging
 from distributed_model_parallel_tpu.models.layers import Context, Layer
 from distributed_model_parallel_tpu.ops.grad_reduction import (
+    MONOLITHIC_BUCKET_MB,
     bucketed_pmean,
     data_replica_index,
+)
+from distributed_model_parallel_tpu.ops.wire_codec import (
+    check_compression,
+    require_dcn_axis,
 )
 from distributed_model_parallel_tpu.runtime.mesh import (
     data_axis_names,
@@ -310,6 +315,17 @@ class DDPEngine:
     # Chunk the hierarchical exchange so per-chunk expert FFN compute
     # overlaps the next hop (expert_dispatch="hierarchical" only).
     expert_overlap: bool = False
+    # Compress the cross-slice 'dcn' hop of EVERY explicit exchange in
+    # the step — the bucket reduction's per-bucket shard exchange and
+    # the hierarchical MoE dispatch's regrouped messages — to this wire
+    # dtype ("none" | "bf16" | "int8", `ops/wire_codec.py`). Master
+    # weights, the intra-slice rings, and every accumulate stay in the
+    # math dtype; requires a MeshSpec(dcn=K) factored mesh. Under
+    # grad_reduction="monolithic" the reduction lowers through ONE flat
+    # bucket per dtype (the monolithic pmean has no dcn seam to
+    # compress), keeping the single-flat-buffer shape while the 'dcn'
+    # hop rides the wire dtype.
+    dcn_compression: str = "none"
 
     def __post_init__(self):
         if self.grad_reduction not in (
@@ -319,6 +335,7 @@ class DDPEngine:
                 "grad_reduction must be 'monolithic', 'bucketed' or "
                 f"'overlapped', got {self.grad_reduction!r}"
             )
+        check_compression(self.dcn_compression)
         if self.expert_dispatch not in (None, "hierarchical"):
             raise ValueError(
                 "expert_dispatch must be None or 'hierarchical', got "
@@ -340,6 +357,7 @@ class DDPEngine:
             parts = self.model.parts
         mesh = self.mesh
         d_axes, ici_axis, dcn_axis = data_hierarchy_axes(mesh)
+        wire = require_dcn_axis(self.dcn_compression, dcn_axis)
         self._repl = NamedSharding(mesh, P())
         self._batch = NamedSharding(mesh, P(d_axes))
         bn_axis = d_axes if self.sync_bn else None
@@ -356,7 +374,7 @@ class DDPEngine:
 
             ed = LocalExpertDispatch(
                 ici_axis=ici_axis, dcn_axis=dcn_axis,
-                overlap=self.expert_overlap,
+                overlap=self.expert_overlap, dcn_compression=wire,
             )
 
         @partial(
@@ -392,7 +410,7 @@ class DDPEngine:
                     with jax.named_scope(f"grad_reduce_stage{k}"):
                         return bucketed_pmean(
                             stage_grads, ici_axis, dcn_axis,
-                            bucket_mb=bucket_mb,
+                            bucket_mb=bucket_mb, dcn_compression=wire,
                         )
 
                 def loss_head(logits):
@@ -429,7 +447,17 @@ class DDPEngine:
                     # The Reducer path: per-bucket rings, hierarchical
                     # over a dcn×ici mesh (`ops/grad_reduction.py`).
                     grads = bucketed_pmean(
-                        grads, ici_axis, dcn_axis, bucket_mb=bucket_mb
+                        grads, ici_axis, dcn_axis, bucket_mb=bucket_mb,
+                        dcn_compression=wire,
+                    )
+                elif wire != "none":
+                    # Monolithic + compression: one flat bucket per
+                    # dtype through the hierarchical path, so the 'dcn'
+                    # hop has a seam to compress (class docstring).
+                    grads = bucketed_pmean(
+                        grads, ici_axis, dcn_axis,
+                        bucket_mb=MONOLITHIC_BUCKET_MB,
+                        dcn_compression=wire,
                     )
                 else:
                     # THE all-reduce: mean-over-global-batch gradient in

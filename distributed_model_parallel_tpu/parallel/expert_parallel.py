@@ -111,6 +111,12 @@ class ExpertParallelEngine(TensorParallelEngine):
     # overlaps communication of chunk k+1 (hierarchical mode only; same
     # math, same tagged hop count, different dependency structure).
     overlap: bool = False
+    # Compress the cross-slice 'dcn' messages of the hierarchical
+    # exchange (BOTH directions, backward mirrored through the
+    # custom_vjp) to this wire dtype ("none" | "bf16" | "int8",
+    # `ops/wire_codec.py`). Hierarchical dispatch on a MeshSpec(dcn=K)
+    # mesh only — the gspmd flat exchange has no explicit dcn seam.
+    dcn_compression: str = "none"
 
     def __post_init__(self):
         if self.dispatch not in ("gspmd", "hierarchical"):
@@ -123,6 +129,21 @@ class ExpertParallelEngine(TensorParallelEngine):
                 "overlap=True chunks the hierarchical exchange; it has "
                 "no effect under dispatch='gspmd' — set "
                 "dispatch='hierarchical' or drop overlap"
+            )
+        from distributed_model_parallel_tpu.ops.wire_codec import (
+            check_compression,
+        )
+
+        check_compression(self.dcn_compression)
+        if (
+            self.dcn_compression != "none"
+            and self.dispatch != "hierarchical"
+        ):
+            raise ValueError(
+                "dcn_compression compresses the hierarchical "
+                "exchange's cross-slice messages; the gspmd dispatch "
+                "has no explicit 'dcn' hop — set "
+                "dispatch='hierarchical' or drop dcn_compression"
             )
         if self.dispatch == "hierarchical":
             if (
@@ -139,12 +160,26 @@ class ExpertParallelEngine(TensorParallelEngine):
                 ExpertDispatch,
             )
 
+            if self.dcn_compression != "none":
+                from distributed_model_parallel_tpu.ops.wire_codec import (
+                    require_dcn_axis,
+                )
+                from distributed_model_parallel_tpu.runtime.mesh import (
+                    data_hierarchy_axes,
+                )
+
+                require_dcn_axis(
+                    self.dcn_compression,
+                    data_hierarchy_axes(self.mesh)[2],
+                    what="MoE exchange",
+                )
             # Swap the default 'expert'-axis layout for the data-fabric
             # one (an explicit rules= override wins).
             if self.rules is EXPERT_RULES:
                 self.rules = hierarchical_expert_rules(self.mesh)
             self._expert_dispatch = ExpertDispatch(
-                self.mesh, overlap=self.overlap
+                self.mesh, overlap=self.overlap,
+                dcn_compression=self.dcn_compression,
             )
         super().__post_init__()
 

@@ -64,8 +64,13 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 from distributed_model_parallel_tpu.models import staging
 from distributed_model_parallel_tpu.models.layers import Context
 from distributed_model_parallel_tpu.ops.grad_reduction import (
+    MONOLITHIC_BUCKET_MB,
     bucketed_pmean,
     data_replica_index,
+)
+from distributed_model_parallel_tpu.ops.wire_codec import (
+    check_compression,
+    require_dcn_axis,
 )
 from distributed_model_parallel_tpu.parallel.data_parallel import (
     TrainState,
@@ -158,6 +163,14 @@ class FSDPEngine(TensorParallelEngine):
     # Backward segment count under "overlapped" (0 = auto: min(4, number
     # of model blocks)).
     overlap_stages: int = 0
+    # Compress the cross-slice 'dcn' hop of each bucket's reduction to
+    # this wire dtype ("none" | "bf16" | "int8", `ops/wire_codec.py`) —
+    # see DDPEngine.dcn_compression. Requires a MeshSpec(dcn=K) mesh.
+    # Under grad_reduction="monolithic" the declarative jit step has no
+    # explicit dcn seam, so compression selects the EXPLICIT shard_map
+    # step with one flat bucket per dtype (same at-rest 1/N layout,
+    # checkpoints interoperate).
+    dcn_compression: str = "none"
 
     def __post_init__(self):
         if self.rules:
@@ -174,7 +187,12 @@ class FSDPEngine(TensorParallelEngine):
                 "grad_reduction must be 'monolithic', 'bucketed' or "
                 f"'overlapped', got {self.grad_reduction!r}"
             )
-        if self.grad_reduction in ("bucketed", "overlapped"):
+        check_compression(self.dcn_compression)
+        explicit = (
+            self.grad_reduction in ("bucketed", "overlapped")
+            or self.dcn_compression != "none"
+        )
+        if explicit:
             if self.collective_matmul:
                 # The explicit step below never threads a matmul policy
                 # through Context — silently dropping the flag would
@@ -209,13 +227,19 @@ class FSDPEngine(TensorParallelEngine):
         eager per-stage bucket reduction."""
         mesh = self.mesh
         d_axes, ici_axis, dcn_axis = data_hierarchy_axes(mesh)
+        wire = require_dcn_axis(self.dcn_compression, dcn_axis)
         n_data = data_axis_size(mesh)
         self._repl = NamedSharding(mesh, P())
         self._batch = NamedSharding(mesh, P(d_axes))
         cdt = self.compute_dtype
         tf = self.input_transform
         model = self.model
-        bucket_mb = self.bucket_mb
+        # Monolithic + compression = ONE flat bucket per dtype (class
+        # docstring): the flat-buffer machinery without the splitting.
+        bucket_mb = (
+            self.bucket_mb if self.grad_reduction != "monolithic"
+            else MONOLITHIC_BUCKET_MB
+        )
 
         key_aval = jax.ShapeDtypeStruct((2,), jnp.uint32)
         p_aval, s_aval = jax.eval_shape(model.init, key_aval)
@@ -310,7 +334,8 @@ class FSDPEngine(TensorParallelEngine):
                 loss_fn, has_aux=True
             )(full_params, ts.model_state)
             grads = bucketed_pmean(
-                grads, ici_axis, dcn_axis, bucket_mb=bucket_mb
+                grads, ici_axis, dcn_axis, bucket_mb=bucket_mb,
+                dcn_compression=wire,
             )
             params, opt_state = self.optimizer.update(
                 ts.params, ts.opt_state, slice_tree(grads, pspecs), lr
@@ -393,7 +418,8 @@ class FSDPEngine(TensorParallelEngine):
                     dp, dx = vjp_fn((cot, jnp.ones_like(a)))
                 with jax.named_scope(f"grad_reduce_stage{k}"):
                     dp = bucketed_pmean(
-                        dp, ici_axis, dcn_axis, bucket_mb=bucket_mb
+                        dp, ici_axis, dcn_axis, bucket_mb=bucket_mb,
+                        dcn_compression=wire,
                     )
                     stage_grads[k] = slice_tree(dp, stage_specs[k])
                 cot = dx

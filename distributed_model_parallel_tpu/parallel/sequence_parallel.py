@@ -53,9 +53,11 @@ from distributed_model_parallel_tpu.ops.ring_attention import (
     ulysses_attention,
 )
 from distributed_model_parallel_tpu.ops.grad_reduction import (
+    MONOLITHIC_BUCKET_MB,
     bucketed_psum,
     data_replica_index,
 )
+from distributed_model_parallel_tpu.ops.wire_codec import require_dcn_axis
 from distributed_model_parallel_tpu.parallel.data_parallel import (
     TrainState,
     _metrics,
@@ -352,6 +354,14 @@ class CausalLMSequenceParallelEngine:
     # Backward segment count under "overlapped" (0 = auto: min(4,
     # cfg.num_layers)).
     overlap_stages: int = 0
+    # Compress the cross-slice 'dcn' hop of the DATA-axis bucket
+    # reduction to this wire dtype ("none" | "bf16" | "int8",
+    # `ops/wire_codec.py`) — the 'seq' psum (complementary per-shard
+    # pieces, intra-slice) stays in the math dtype. Requires a
+    # MeshSpec(dcn=K) mesh; under grad_reduction="monolithic" the data
+    # reduction lowers through one flat bucket per dtype so the 'dcn'
+    # hop has a seam to compress (see DDPEngine.dcn_compression).
+    dcn_compression: str = "none"
 
     def __post_init__(self):
         from distributed_model_parallel_tpu.models.gpt import (
@@ -381,6 +391,14 @@ class CausalLMSequenceParallelEngine:
         bucketed = self.grad_reduction == "bucketed"
         overlapped = self.grad_reduction == "overlapped"
         bucket_mb = self.bucket_mb
+        wire = require_dcn_axis(self.dcn_compression, dcn_axis)
+        # Monolithic + compression routes the data reduction through
+        # one flat bucket per dtype (class docstring).
+        use_buckets = bucketed or (wire != "none" and not overlapped)
+        data_bucket_mb = (
+            bucket_mb if self.grad_reduction != "monolithic"
+            else MONOLITHIC_BUCKET_MB
+        )
         cfg = self.cfg
         if getattr(cfg, "num_experts", 0) > 0:
             # Same objection as the BERT SP engine: per-shard routing
@@ -539,6 +557,7 @@ class CausalLMSequenceParallelEngine:
                                 stage_grads,
                             ),
                             ici_axis, dcn_axis, bucket_mb=bucket_mb,
+                            dcn_compression=wire,
                         )
 
                 stage_params = partition_tree(ts.params, over_cuts)
@@ -564,7 +583,7 @@ class CausalLMSequenceParallelEngine:
                     loss_fn, has_aux=True
                 )(ts.params)
                 n_global = lax.psum(m["count"], reduce_axes)
-                if bucketed:
+                if use_buckets:
                     # 'seq' first (complementary per-shard pieces — one
                     # fused psum over the TP-style axis), then the
                     # Reducer-style buckets over the data fabric(s).
@@ -572,7 +591,8 @@ class CausalLMSequenceParallelEngine:
                         jax.tree_util.tree_map(
                             lambda g: lax.psum(g, "seq"), grads
                         ),
-                        ici_axis, dcn_axis, bucket_mb=bucket_mb,
+                        ici_axis, dcn_axis, bucket_mb=data_bucket_mb,
+                        dcn_compression=wire,
                     )
                     grads = jax.tree_util.tree_map(
                         lambda g: g / jnp.maximum(n_global, 1.0), grads

@@ -272,6 +272,42 @@ def main():
           f"{eff_two_level:.3f} (hierarchical bucketed, no overlap) .. "
           f"{eff_two_level_overlap:.3f} (full overlap)")
 
+    # ---- 3b'. compressed 'dcn' wire on the bucketed reducer ----------
+    # (`ops/wire_codec.py`, PR 11). The intra-slice legs stay f32; only
+    # the cross-slice term scales with the wire itemsize. int8 adds one
+    # f32 scale sidecar per hop (4 B x 2(K-1) x n_buckets — noise) and
+    # one extra tiny ppermute per payload hop (counted in alpha).
+    dcn_beta_f32_s = (
+        2 * (DCN_SLICES - 1) / DCN_SLICES
+        * (opt_ar_bytes / ici) / BW_DCN_EFFECTIVE
+    )
+    wire_rows = {}
+    for wire, wbytes, sidecar_hops in (
+        ("bf16", 2, 0), ("int8", 1, 1)
+    ):
+        dcn_beta_wire_s = dcn_beta_f32_s * wbytes / 4
+        beta_wire_s = (
+            2 * (ici - 1) / ici * opt_ar_bytes / BW_ICI_EFFECTIVE
+            + dcn_beta_wire_s
+        )
+        alpha_wire_s = n_buckets * (
+            2 * (ici - 1) * ALPHA_HOP_S
+            + (1 + sidecar_hops) * 2 * (DCN_SLICES - 1)
+            * ALPHA_DCN_HOP_S
+        )
+        comm_wire_s = beta_wire_s + alpha_wire_s
+        eff_wire = MEASURED_STEP_S / (MEASURED_STEP_S + comm_wire_s)
+        wire_rows[wire] = dict(
+            dcn_beta_s=round(dcn_beta_wire_s, 6),
+            comm_s=round(comm_wire_s, 6),
+            eff=round(eff_wire, 4),
+        )
+        print(f"compressed grad wire ({wire}): dcn leg "
+              f"{dcn_beta_f32_s*1e3:.2f} -> {dcn_beta_wire_s*1e3:.2f} "
+              f"ms, total comm {comm_wire_s*1e3:.2f} ms, "
+              f"efficiency {eff_wire:.3f} (f32 hierarchical: "
+              f"{eff_two_level:.3f})")
+
     # ---- 3c. two-level a2a: the hierarchical MoE token exchange ------
     # One routed layer's dispatch+combine at 64 chips as DCN_SLICES x
     # ici (`ops/expert_dispatch.py`). The FLAT all-to-all sends each of
@@ -283,10 +319,10 @@ def main():
     # DCN hops — and the (ici-1)/ici intra-slice share rides ICI
     # exclusively. OVERLAPPED additionally hides the exchange behind
     # the per-chunk expert FFN (the chunked ppermute decomposition).
-    moe_x_bytes = int(
-        MOE_TOP_K * MOE_CAPACITY_FACTOR * MOE_TOKENS_PER_CHIP
-        * MOE_DIM * 2  # bf16 wire
+    moe_x_elems = int(
+        MOE_TOP_K * MOE_CAPACITY_FACTOR * MOE_TOKENS_PER_CHIP * MOE_DIM
     )
+    moe_x_bytes = moe_x_elems * 2  # bf16 activations (the §3c shape)
     # per-exchange (dispatch or combine), per device:
     a2a_flat_s = (
         (DCN_SLICES - 1) / DCN_SLICES * moe_x_bytes / BW_DCN_EFFECTIVE
@@ -320,6 +356,35 @@ def main():
           f"{moe_layer_two_level_s*1e3:.2f} ms, overlapped "
           f"{moe_layer_overlap_s*1e3:.2f} ms "
           f"(exchange {'hidden' if moe_ffn_s >= 2*a2a_two_level_s else 'exposed'})")
+
+    # ---- 3c'. compressed 'dcn' wire on the MoE dispatch --------------
+    # The intra-slice regroup stays at the activation dtype (bf16
+    # here); only the cross-slice messages scale with the wire
+    # itemsize. f32 is the uncompressed worst case (f32 activations,
+    # no codec); int8 quarters it.
+    moe_wire_rows = {}
+    for wire, wbytes in (("f32", 4), ("bf16", 2), ("int8", 1)):
+        dcn_leg_s = (
+            (DCN_SLICES - 1) / DCN_SLICES
+            * (moe_x_elems * wbytes) / BW_DCN_EFFECTIVE
+        )
+        a2a_wire_s = (
+            dcn_leg_s
+            + (ici - 1) / ici * moe_x_bytes / BW_ICI_EFFECTIVE
+            + (DCN_SLICES - 1) * ALPHA_DCN_HOP_S
+            + (ici - 1) * ALPHA_HOP_S
+        )
+        layer_s = 2 * a2a_wire_s + moe_ffn_s
+        layer_overlap_s = max(2 * a2a_wire_s, moe_ffn_s)
+        moe_wire_rows[wire] = dict(
+            a2a_s=round(a2a_wire_s, 6),
+            layer_s=round(layer_s, 6),
+            layer_overlapped_s=round(layer_overlap_s, 6),
+        )
+        print(f"compressed dispatch wire ({wire}): "
+              f"{a2a_wire_s*1e3:.2f} ms/exchange, per layer "
+              f"{layer_s*1e3:.2f} ms unfused / "
+              f"{layer_overlap_s*1e3:.2f} ms overlapped")
 
     out = {
         "n_devices": N,
@@ -372,6 +437,9 @@ def main():
         "moe_layer_overlapped_s": round(moe_layer_overlap_s, 6),
         "moe_dcn_hops_flat": (DCN_SLICES - 1) * ici,
         "moe_dcn_hops_hierarchical": DCN_SLICES - 1,
+        # compressed 'dcn' wire rows (PR 11, ops/wire_codec.py)
+        "grad_wire_rows": wire_rows,
+        "moe_wire_rows": moe_wire_rows,
     }
     path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                         "scaling64.json")

@@ -251,6 +251,82 @@ def test_overlapped_flag_guards():
         ])
 
 
+def test_dcn_compression_flag_guards():
+    """--dcn-compression misuse fails fast, naming the flag and the
+    fix: the wire codec targets the cross-slice hop, so it needs a
+    'dcn'-factored mesh and an engine with an explicit dcn seam."""
+    from distributed_model_parallel_tpu.cli import lm
+
+    dp_args = data_parallel.build_parser().parse_args([])
+    assert dp_args.dcn_compression == "none"
+    assert lm.build_parser().parse_args([]).dcn_compression == "none"
+    with pytest.raises(SystemExit):  # no 'dcn' axis to compress
+        data_parallel.main([
+            "--engine", "ddp", "--dcn-compression", "int8",
+            "--model", "tinycnn", "-type", "Synthetic",
+        ])
+    with pytest.raises(SystemExit):  # gspmd jit has no explicit hop
+        data_parallel.main([
+            "--dcn-compression", "bf16", "--dcn-slices", "2",
+            "--model", "tinycnn", "-type", "Synthetic",
+        ])
+    with pytest.raises(SystemExit):  # neither does tp
+        data_parallel.main([
+            "--engine", "tp", "--dcn-compression", "bf16",
+            "--dcn-slices", "2", "--model", "bert_tiny",
+            "-type", "SyntheticText",
+        ])
+    with pytest.raises(SystemExit):  # lm: no 'dcn' axis to compress
+        lm.main(["--dcn-compression", "bf16"])
+    with pytest.raises(SystemExit):  # pipeline reduces over wires
+        lm.main([
+            "--pipeline-stages", "2", "--dcn-compression", "int8",
+            "--dcn-slices", "2",
+        ])
+    with pytest.raises(SystemExit):  # gspmd MoE has no explicit hop
+        lm.main([
+            "--moe-experts", "8", "--dcn-compression", "int8",
+            "--dcn-slices", "2",
+        ])
+
+
+def test_data_parallel_cli_ddp_quantized_dcn(tmp_path, monkeypatch):
+    """--dcn-compression int8 drives the full entry point: bucketed
+    hierarchical reducer on the 2x4 dcn×ici mesh with the int8 wire on
+    the cross-slice hop (ops/wire_codec.py)."""
+    monkeypatch.chdir(tmp_path)
+    result = data_parallel.main([
+        "--engine", "ddp", "--grad-reduction", "bucketed",
+        "--bucket-mb", "0.25", "--dcn-slices", "2",
+        "--dcn-compression", "int8", "--model", "tinycnn",
+        "-type", "Synthetic", "-b", "64", "--val-batch-size", "128",
+        "--epochs", "1", "--steps-per-epoch", "2",
+    ])
+    assert len(result["history"]) == 1
+
+
+@pytest.mark.slow
+def test_lm_cli_quantized_dcn_moe(tmp_path, monkeypatch):
+    """--moe-dispatch hierarchical --dcn-compression bf16 reaches the
+    expert-parallel LM engine end-to-end with the compressed dispatch
+    wire. `slow` (tier-1 budget); tier-1 twins:
+    test_data_parallel_cli_ddp_quantized_dcn (the flag surface e2e) and
+    tests/test_wire_codec.py::test_ep_compressed_dispatch_matches_f32
+    (the engine math)."""
+    from distributed_model_parallel_tpu.cli import lm
+
+    monkeypatch.chdir(tmp_path)
+    result = lm.main([
+        "--dim", "16", "--layers", "2", "--heads", "2",
+        "--seq-len", "16", "-b", "8", "--epochs", "1",
+        "--steps-per-epoch", "2", "--corpus-tokens", "2048",
+        "--moe-experts", "8", "--moe-dispatch", "hierarchical",
+        "--moe-overlap", "--dcn-slices", "2",
+        "--dcn-compression", "bf16",
+    ])
+    assert len(result["history"]) == 1
+
+
 @pytest.mark.slow
 def test_lm_cli_bucketed(tmp_path, monkeypatch):
     """The lm CLI's --grad-reduction bucketed reaches the causal-LM
@@ -619,6 +695,8 @@ def test_serving_flag_guards():
         serve.main(["--overlap-stages", "2"])
     with pytest.raises(SystemExit):  # serving meshes are model/seq
         serve.main(["--dcn-slices", "2"])
+    with pytest.raises(SystemExit):  # no dcn fabric to compress
+        serve.main(["--dcn-compression", "int8"])
     with pytest.raises(SystemExit):  # rings need the tp layout
         serve.main(["--collective-matmul"])
     with pytest.raises(SystemExit):  # tp with 1 shard = replicated

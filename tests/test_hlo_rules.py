@@ -609,6 +609,150 @@ def test_moe_hierarchical_untagged_permutes_do_not_count():
     assert found and "0 moe_ring-scoped" in found[0].message
 
 
+# ---------------------------------------------- dcn-compressed-payload
+
+
+def compressed_target(**kw):
+    """DDP bucketed+int8 on the 2x4 hybrid: one 64-elem padded bucket
+    -> 1/ici shard 16 elems -> 2(K-1)=2 dcn hops of 8 int8 elems each,
+    one f32 scalar sidecar per hop."""
+    base = dict(
+        name="t", engine="ddp", grad_reduction="bucketed",
+        data_axes=("dcn", "ici"), ici_axis="ici", dcn_axis="dcn",
+        ici_size=4, dcn_size=2,
+        bucket_plans=(((64, "f32"),),),
+        dcn_compression="int8",
+        dcn_wire_chunks=((8, "s8"), (8, "s8")),
+        dcn_ring_records=(
+            (("dcn",), "s8", "jit(f)/dcn_wire", 8),
+            (("dcn",), "f32", "jit(f)/dcn_scale", 1),
+            (("dcn",), "s8", "jit(f)/dcn_wire", 8),
+            (("dcn",), "f32", "jit(f)/dcn_scale", 1),
+            # intra-slice ring traffic stays f32 and must be ignored
+            (("ici",), "f32", "jit(f)/bucket_ring", 16),
+        ),
+    )
+    base.update(kw)
+    return LintTarget(**base)
+
+
+@pytest.mark.hlo_rule("dcn-compressed-payload", "positive")
+def test_dcn_compressed_fires_on_f32_hop_and_grad_all_reduce():
+    # An UNCODED f32 ppermute crossing 'dcn' in the trace, a payload
+    # hop in the wrong dtype, AND a grad-sized f32 all-reduce crossing
+    # 'dcn' in the compiled HLO: every half of the contract fires.
+    hlo = module([allreduce("ar", "p", DCN_GROUPS, shape="f32[100]")],
+                 params=("p: f32[100]",))
+    found = check(
+        "dcn-compressed-payload",
+        compressed_target(dcn_ring_records=(
+            (("dcn",), "f32", "jit(f)/bwd", 64),
+            (("dcn",), "f32", "jit(f)/dcn_wire", 8),
+            (("dcn",), "f32", "jit(f)/dcn_wire", 8),
+        )),
+        hlo, MESH_2x4,
+    )
+    msgs = " | ".join(f.message for f in found)
+    assert "uncoded ppermute crosses 'dcn'" in msgs
+    assert "expected compressed chunks" in msgs
+    assert "all-reduce crosses 'dcn'" in msgs
+
+
+@pytest.mark.hlo_rule("dcn-compressed-payload", "negative")
+def test_dcn_compressed_pinned_wire_is_clean():
+    # The exact chunk multiset in int8 + one sidecar per hop + a
+    # state-shaped BN psum (allowlisted) + scalar metrics: clean.
+    hlo = module([
+        allreduce("bn", "p", "{{0,1,2,3,4,5,6,7}}", shape="f32[16]"),
+        allreduce("m", "p", "{{0,1,2,3,4,5,6,7}}", shape="f32[]"),
+    ])
+    assert check(
+        "dcn-compressed-payload",
+        compressed_target(state_leaf_shapes=((16,),)), hlo, MESH_2x4,
+    ) == []
+
+
+def test_dcn_compressed_missing_records_is_a_finding():
+    """A compressed combo whose builder collected no trace records must
+    surface, not silently pass."""
+    found = check(
+        "dcn-compressed-payload",
+        compressed_target(dcn_ring_records=()), module([]), MESH_2x4,
+    )
+    assert found and "not checked" in found[0].message
+
+
+def test_dcn_compressed_missing_expectation_is_a_finding():
+    found = check(
+        "dcn-compressed-payload",
+        compressed_target(dcn_wire_chunks=(), dcn_wire_hops=None),
+        module([]), MESH_2x4,
+    )
+    assert found and any(
+        "payload pin was not checked" in f.message for f in found
+    )
+
+
+def test_dcn_compressed_sidecar_accounting():
+    """int8 demands exactly one f32 scalar sidecar per payload hop; a
+    bf16 combo must carry none."""
+    found = check(
+        "dcn-compressed-payload",
+        compressed_target(dcn_ring_records=(
+            (("dcn",), "s8", "jit(f)/dcn_wire", 8),
+            (("dcn",), "s8", "jit(f)/dcn_wire", 8),
+            (("dcn",), "f32", "jit(f)/dcn_scale", 1),
+        )),
+        module([]), MESH_2x4,
+    )
+    assert found and "1 dcn_scale sidecars for 2" in found[0].message
+    found = check(
+        "dcn-compressed-payload",
+        compressed_target(
+            dcn_compression="bf16",
+            dcn_wire_chunks=((8, "bf16"), (8, "bf16")),
+            dcn_ring_records=(
+                (("dcn",), "bf16", "jit(f)/dcn_wire", 8),
+                (("dcn",), "bf16", "jit(f)/dcn_wire", 8),
+                (("dcn",), "f32", "jit(f)/dcn_scale", 1),
+            ),
+        ),
+        module([]), MESH_2x4,
+    )
+    assert found and "cast codec has no scale" in found[0].message
+
+
+def test_dcn_compressed_hop_count_pin_for_moe():
+    """The EP form of the pin: hop COUNT + wire dtype (chunk shapes are
+    model-dependent), plus the dispatch-sized all-to-all ban."""
+    ep = compressed_target(
+        engine="ep", grad_reduction="monolithic",
+        moe_dispatch="hierarchical", bucket_plans=(),
+        dcn_wire_chunks=(), dcn_wire_hops=4,
+        dcn_ring_records=tuple(
+            (("dcn",), "s8", "jit(f)/moe_ring/dcn_wire", 48)
+            for _ in range(4)
+        ) + tuple(
+            (("dcn",), "f32", "jit(f)/dcn_scale", 1) for _ in range(4)
+        ),
+    )
+    assert check("dcn-compressed-payload", ep, module([]), MESH_2x4) == []
+    # short chain + a surviving flat all-to-all over 'dcn'
+    import dataclasses
+
+    bad = check(
+        "dcn-compressed-payload",
+        dataclasses.replace(ep, dcn_ring_records=(
+            (("dcn",), "s8", "jit(f)/moe_ring/dcn_wire", 48),
+            (("dcn",), "f32", "jit(f)/dcn_scale", 1),
+        )),
+        module([alltoall("a2a", "p", DCN_GROUPS)]), MESH_2x4,
+    )
+    msgs = " | ".join(f.message for f in bad)
+    assert "expected exactly 4" in msgs
+    assert "all-to-all crosses 'dcn'" in msgs
+
+
 # ------------------------------------------------- donated-step-aliased
 
 

@@ -42,6 +42,13 @@ TIER1_COMBOS = [
     # twin (tools/tier1.sh lints this exact combo before the suite)
     Combo("ep", 4, dcn=2, moe_dispatch="hierarchical",
           moe_overlap=True),
+    # quantized 'dcn' wire (dcn-compressed-payload): int8 grad buckets
+    # with scale sidecars (the pre-gate twin) + the bf16 compressed
+    # MoE dispatch
+    Combo("ddp", 4, grad_reduction="bucketed", dcn=2,
+          dcn_compression="int8", model="tinycnn"),
+    Combo("ep", 4, dcn=2, moe_dispatch="hierarchical",
+          dcn_compression="bf16"),
 ]
 
 

@@ -1,0 +1,599 @@
+"""Quantized 'dcn' wire (`ops/wire_codec.py` + the compressed hops of
+`ops/grad_reduction.py` / `ops/expert_dispatch.py`): codec unit tests
+with explicit error bounds, the compressed cross-slice reduction
+pinned against `lax.psum` at its documented budget, jaxpr-level dtype
+pins on every hop, and engine-level parity sweeps — compression ×
+{monolithic, bucketed, overlapped} against the f32 control on BOTH the
+plain and the 2×(S/2) hybrid mesh, plus a 5-step trajectory test
+quantifying drift. The f32 ("none") wire stays bit-identical to the
+uncompressed lowering everywhere (rtol 1e-5 paths untouched); the
+LOOSENED budgets apply only to the compressed hop:
+
+    bf16  one rounding per hop              -> grads/trajectories at
+                                               rtol 1e-2 (observed
+                                               ~1e-5 on these models)
+    int8  per-chunk absmax/254 per crossing -> elementwise
+                                               <= (K+1)*absmax/254 per
+                                               bucket (op level), and
+                                               trajectories at rtol
+                                               5e-2 (observed ~1e-4)
+"""
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+from distributed_model_parallel_tpu.models.tinycnn import tiny_cnn
+from distributed_model_parallel_tpu.ops.grad_reduction import (
+    bucket_pad_multiple,
+    bucketed_pmean,
+    compressed_dcn_psum,
+)
+from distributed_model_parallel_tpu.ops.wire_codec import (
+    COMPRESSION_MODES,
+    check_compression,
+    wire_decode,
+    wire_encode,
+    wire_itemsize,
+)
+from distributed_model_parallel_tpu.parallel.data_parallel import DDPEngine
+from distributed_model_parallel_tpu.runtime.compat import shard_map
+from distributed_model_parallel_tpu.runtime.mesh import MeshSpec, make_mesh
+from distributed_model_parallel_tpu.training.optim import SGD
+
+# Documented parity budgets for the COMPRESSED hop (module docstring;
+# INTERNALS §12 carries the same numbers). f32 paths stay at 1e-5.
+BF16_TRAJ_RTOL = 1e-2
+INT8_TRAJ_RTOL = 5e-2
+
+
+# ---------------------------------------------------------- codec units
+
+
+def test_codec_surface():
+    assert COMPRESSION_MODES == ("none", "bf16", "int8")
+    assert [wire_itemsize(w) for w in COMPRESSION_MODES] == [4, 2, 1]
+    assert check_compression("bf16") == "bf16"
+    with pytest.raises(ValueError, match="dcn_compression"):
+        check_compression("fp8")
+
+
+@pytest.mark.parametrize("seed", [0, 3])
+def test_int8_roundtrip_error_bound(seed):
+    """|decode(encode(x)) - x| <= absmax/254 elementwise (round-half of
+    one scale step) — the per-chunk bound every downstream budget
+    derives from."""
+    rng = np.random.RandomState(seed)
+    x = jnp.asarray(rng.randn(257).astype(np.float32) * 3.0)
+    payload, scale = wire_encode("int8", x)
+    assert payload.dtype == jnp.int8 and scale.shape == ()
+    dec = wire_decode("int8", payload, scale, x.dtype)
+    bound = float(jnp.max(jnp.abs(x))) / 254.0 + 1e-7
+    assert float(jnp.max(jnp.abs(dec - x))) <= bound
+
+
+def test_bf16_roundtrip_error_bound():
+    rng = np.random.RandomState(1)
+    x = jnp.asarray(rng.randn(64).astype(np.float32))
+    payload, scale = wire_encode("bf16", x)
+    assert payload.dtype == jnp.bfloat16 and scale is None
+    dec = wire_decode("bf16", payload, None, x.dtype)
+    # one bf16 rounding: 2^-8 relative
+    np.testing.assert_allclose(
+        np.asarray(dec), np.asarray(x), rtol=2 ** -8, atol=1e-30
+    )
+
+
+def test_int8_zero_and_denormal_chunks():
+    """All-zero chunks decode to EXACT zeros (the absmax floor guards
+    the 0/127 scale); denormal-magnitude chunks stay finite and keep
+    the relative bound."""
+    z = jnp.zeros((16,), jnp.float32)
+    payload, scale = wire_encode("int8", z)
+    assert bool(jnp.all(payload == 0)) and bool(jnp.isfinite(scale))
+    np.testing.assert_array_equal(
+        np.asarray(wire_decode("int8", payload, scale, z.dtype)),
+        np.zeros(16, np.float32),
+    )
+    # Tiny-but-NORMAL magnitudes keep the relative bound (the
+    # ABSMAX_FLOOR keeps the derived scale a normal f32, so nothing
+    # 0-divides or flushes in the codec itself).
+    small = jnp.asarray(
+        np.array([1e-35, -3e-35, 5e-36, 0.0], np.float32)
+    )
+    p, s = wire_encode("int8", small)
+    dec = np.asarray(wire_decode("int8", p, s, small.dtype))
+    assert np.all(np.isfinite(dec))
+    assert np.max(np.abs(dec - np.asarray(small))) <= 3e-35 / 254 * 1.01
+    # DENORMAL inputs are flushed by the backend before the codec sees
+    # them (FTZ); the codec must stay finite and the error can never
+    # exceed the largest denormal — f32's normal-min.
+    den = jnp.asarray(np.array([1e-38, -1e-39, 0.0], np.float32))
+    p, s = wire_encode("int8", den)
+    dec = np.asarray(wire_decode("int8", p, s, den.dtype))
+    assert np.all(np.isfinite(dec))
+    assert np.max(np.abs(dec - np.asarray(den))) <= float(
+        np.finfo(np.float32).tiny
+    )
+
+
+def test_int8_encode_preserves_bf16_chunk_dtype_roundtrip():
+    x = jnp.asarray(np.linspace(-2, 2, 32), jnp.bfloat16)
+    p, s = wire_encode("int8", x)
+    dec = wire_decode("int8", p, s, x.dtype)
+    assert dec.dtype == jnp.bfloat16
+
+
+def test_bucket_pad_multiple():
+    assert bucket_pad_multiple(4, 2, "none") == 4
+    assert bucket_pad_multiple(4, 2, "int8") == 8
+    assert bucket_pad_multiple(4, 1, "int8") == 4  # no dcn factor
+    assert bucket_pad_multiple(2, 4, "bf16") == 8
+
+
+# ------------------------------------------------ compressed dcn psum
+
+
+@pytest.mark.parametrize("k", [2, 4])
+def test_compressed_dcn_psum_matches_psum_within_bound(k, devices):
+    """The wire-dtype RS+AG decomposition vs `lax.psum` over 'dcn':
+    int8 within (K+1)*absmax/254 elementwise (one codec crossing per
+    received chunk + one on the gather re-encode), bf16 within one
+    rounding of the summed magnitude, f32 exact."""
+    mesh = Mesh(np.array(devices[:k]), ("dcn",))
+    n = 8 * k
+    rng = np.random.RandomState(0)
+    x = jnp.asarray(rng.randn(k * n).astype(np.float32))
+
+    def run(fn):
+        m = shard_map(
+            fn, mesh=mesh, in_specs=(P("dcn"),), out_specs=P("dcn"),
+            check_vma=False,
+        )
+        return np.asarray(jax.jit(m)(x))
+
+    mono = run(lambda v: lax.psum(v, "dcn"))
+    # wire="none" moves the same f32 bytes through the decomposition:
+    # equal up to reduction order (the repo's 1e-5 convention).
+    exact = run(partial(compressed_dcn_psum, dcn_axis="dcn",
+                        wire="none"))
+    np.testing.assert_allclose(exact, mono, rtol=1e-5, atol=1e-6)
+    absmax = float(np.max(np.abs(np.asarray(x))))
+    int8 = run(partial(compressed_dcn_psum, dcn_axis="dcn",
+                       wire="int8"))
+    assert np.max(np.abs(int8 - mono)) <= (k + 1) * absmax / 254 + 1e-6
+    bf16 = run(partial(compressed_dcn_psum, dcn_axis="dcn",
+                       wire="bf16"))
+    np.testing.assert_allclose(bf16, mono, rtol=BF16_TRAJ_RTOL,
+                               atol=(k + 1) * absmax * 2 ** -8)
+
+
+def _grad_tree(rng):
+    """Mixed-dtype pytree with awkward (prime) sizes so every bucket
+    has an uneven tail against the padded ring*dcn size."""
+    return {
+        "w1": jnp.asarray(rng.randn(13, 7), jnp.float32),
+        "b1": jnp.asarray(rng.randn(7), jnp.float32),
+        "w2": jnp.asarray(rng.randn(31, 3), jnp.bfloat16),
+        "scalar": jnp.asarray(rng.randn(), jnp.float32),
+        "w3": jnp.asarray(rng.randn(97), jnp.float32),
+    }
+
+
+@pytest.mark.parametrize("wire", ["bf16", "int8"])
+def test_bucketed_pmean_compressed_uneven_tails(wire, devices):
+    """Compressed `bucketed_pmean` == `lax.pmean` within the codec
+    budget on the 2x4 hybrid mesh, mixed bf16/f32 leaves and uneven
+    tails included (the tail zero-padding crosses the codec as zeros
+    and must come back exact)."""
+    mesh = Mesh(np.array(devices).reshape(2, 4), ("dcn", "ici"))
+    trees = [_grad_tree(np.random.RandomState(i)) for i in range(8)]
+    stacked = jax.tree_util.tree_map(
+        lambda *xs: jnp.stack(xs).reshape((2, 4) + xs[0].shape), *trees
+    )
+    spec = jax.tree_util.tree_map(lambda _: P("dcn", "ici"), stacked)
+
+    def run(fn):
+        def body(t):
+            sq = jax.tree_util.tree_map(
+                lambda v: v.reshape(v.shape[2:]), t
+            )
+            out = fn(sq)
+            return jax.tree_util.tree_map(
+                lambda v: v.reshape((1, 1) + v.shape), out
+            )
+
+        m = shard_map(
+            body, mesh=mesh, in_specs=(spec,), out_specs=spec,
+            check_vma=False,
+        )
+        return jax.tree_util.tree_map(
+            lambda v: np.asarray(v)[0, 0], jax.jit(m)(stacked)
+        )
+
+    mono = run(lambda t: lax.pmean(t, ("dcn", "ici")))
+    got = run(lambda t: bucketed_pmean(
+        t, "ici", "dcn", bucket_mb=0.0005, dcn_compression=wire
+    ))
+    # One budget for every leaf: the bf16 LEAVES' own rounding noise is
+    # dominated by the wire codec's (int8 worst case), so the int8
+    # bound covers both dtypes.
+    for k in mono:
+        np.testing.assert_allclose(
+            np.asarray(mono[k], np.float32),
+            np.asarray(got[k], np.float32),
+            rtol=5e-2, atol=2e-2, err_msg=k,
+        )
+
+
+# --------------------------------------------------- jaxpr dtype pins
+
+
+def test_every_dcn_hop_dtype_pinned_from_jaxpr(devices):
+    """The static truth the hlolint rule reads, checked directly: in a
+    compressed bucketed reduction every traced 'dcn'-crossing ppermute
+    is a `dcn_wire` payload in the wire dtype or (int8) a one-scalar
+    f32 `dcn_scale` sidecar; the intra-slice ring permutes stay in the
+    math dtype."""
+    from distributed_model_parallel_tpu.analysis.lint import (
+        jaxpr_ppermute_records,
+    )
+
+    mesh = Mesh(np.array(devices).reshape(2, 4), ("dcn", "ici"))
+    tree = {"w": jnp.zeros((64, 3), jnp.float32)}
+    spec = jax.tree_util.tree_map(lambda _: P(), tree)
+
+    for wire, tok in (("bf16", "bf16"), ("int8", "int8")):
+        fn = jax.jit(shard_map(
+            partial(bucketed_pmean, ici_axis="ici", dcn_axis="dcn",
+                    bucket_mb=0.001, dcn_compression=wire),
+            mesh=mesh, in_specs=(spec,), out_specs=spec,
+            check_vma=False,
+        ))
+        recs = jaxpr_ppermute_records(fn, tree)
+        dcn = [r for r in recs if "dcn" in r[0]]
+        ici = [r for r in recs if "ici" in r[0]]
+        assert dcn and ici
+        for axes, dt, scope, elems in dcn:
+            if "dcn_scale" in scope:
+                assert (dt, elems) == ("f32", 1)
+            else:
+                assert "dcn_wire" in scope
+                assert dt == ("s8" if wire == "int8" else "bf16")
+        assert all(dt == "f32" for _, dt, _, _ in ici)
+        n_scale = sum("dcn_scale" in r[2] for r in dcn)
+        n_wire = sum("dcn_scale" not in r[2] for r in dcn)
+        assert n_scale == (n_wire if wire == "int8" else 0)
+
+
+def test_moe_dcn_hops_dtype_pinned_from_jaxpr(devices):
+    """Same pin on the MoE exchange, INCLUDING the mirrored backward:
+    trace grad of the exchanged FFN and assert every dcn-crossing hop
+    rides the wire (the custom_vjp keeps cotangents compressed too),
+    while the ici regroup stays f32."""
+    from distributed_model_parallel_tpu.analysis.lint import (
+        jaxpr_ppermute_records,
+    )
+    from distributed_model_parallel_tpu.models.moe import expert_ffn
+    from distributed_model_parallel_tpu.ops.expert_dispatch import (
+        exchanged_expert_ffn,
+    )
+
+    mesh = Mesh(np.array(devices).reshape(2, 4), ("dcn", "ici"))
+    E, D = 8, 8
+    rng = np.random.RandomState(0)
+    x = jnp.asarray(rng.randn(E, 8, 2, D).astype(np.float32))
+    w = {
+        "w_in": jnp.asarray(rng.randn(E, D, D).astype(np.float32)),
+        "b_in": jnp.zeros((E, D), jnp.float32),
+        "w_out": jnp.asarray(rng.randn(E, D, D).astype(np.float32)),
+        "b_out": jnp.zeros((E, D), jnp.float32),
+    }
+    dd = ("dcn", "ici")
+    wspec = {k: P(dd, *([None] * (v.ndim - 1))) for k, v in w.items()}
+
+    for overlap in (False, True):
+        def loss(x, w, overlap=overlap):
+            def local(xl, wl):
+                return exchanged_expert_ffn(
+                    xl, partial(expert_ffn, wl), "ici", "dcn",
+                    overlap, "int8",
+                )
+
+            y = shard_map(
+                local, mesh=mesh,
+                in_specs=(P(None, dd, None, None), wspec),
+                out_specs=P(None, dd, None, None), check_vma=False,
+            )(x, w)
+            return jnp.sum(y * y)
+
+        recs = jaxpr_ppermute_records(jax.grad(loss), x, w)
+        dcn = [r for r in recs if "dcn" in r[0]]
+        assert dcn, "no dcn hops traced"
+        for axes, dt, scope, elems in dcn:
+            if "dcn_scale" in scope:
+                assert (dt, elems) == ("f32", 1)
+            else:
+                assert "dcn_wire" in scope and "moe_ring" in scope
+                assert dt == "s8"
+        assert all(
+            dt == "f32" for axes, dt, _, _ in recs if "ici" in axes
+        )
+
+
+# ---------------------------------------------- engine parity sweeps
+
+
+def _batch():
+    rng = np.random.RandomState(7)
+    return (
+        rng.rand(16, 8, 8, 3).astype(np.float32),
+        rng.randint(0, 10, size=(16,)).astype(np.int32),
+    )
+
+
+def _run(eng, n_steps=3, lr=0.05):
+    ts = eng.init_state(jax.random.PRNGKey(0))
+    x, y = eng.shard_batch(*_batch())
+    traj = []
+    for _ in range(n_steps):
+        ts, m = eng.train_step(ts, x, y, jnp.float32(lr))
+        traj.append(float(m["loss_sum"]))
+    return ts, traj
+
+
+def _budget(wire):
+    return BF16_TRAJ_RTOL if wire == "bf16" else INT8_TRAJ_RTOL
+
+
+# Tier-1 keeps the int8 hybrid smoke of each (mode) — the deepest
+# codec path (sidecars + re-chunked padding); the bf16 twin rides the
+# slow sweep (same assertions, looser codec).
+_WIRE_SWEEP = [
+    pytest.param("bf16", marks=pytest.mark.slow),
+    "int8",
+]
+
+
+@pytest.mark.parametrize("wire", _WIRE_SWEEP)
+def test_ddp_compressed_matches_f32_all_modes(wire, devices):
+    """compression x {monolithic, bucketed, overlapped} on the 2x4
+    hybrid mesh vs the f32 control on BOTH the plain and hybrid mesh:
+    trajectories within the documented wire budget, and the two f32
+    controls agree at rtol 1e-5 (the compressed hop is the ONLY
+    loosened link)."""
+    plain = make_mesh(MeshSpec(data=8))
+    hybrid = make_mesh(MeshSpec(data=8, dcn=2))
+    _, base_plain = _run(DDPEngine(
+        tiny_cnn(10), SGD(), plain, donate=False
+    ))
+    _, base_hybrid = _run(DDPEngine(
+        tiny_cnn(10), SGD(), hybrid, donate=False
+    ))
+    np.testing.assert_allclose(base_hybrid, base_plain, rtol=1e-5)
+    for gr in ("monolithic", "bucketed", "overlapped"):
+        _, traj = _run(DDPEngine(
+            tiny_cnn(10), SGD(), hybrid, donate=False,
+            grad_reduction=gr, bucket_mb=0.02, dcn_compression=wire,
+        ))
+        np.testing.assert_allclose(
+            traj, base_plain, rtol=_budget(wire),
+            err_msg=f"{gr}/{wire}",
+        )
+        assert traj[-1] < traj[0], f"{gr}/{wire} did not descend"
+
+
+@pytest.mark.parametrize("wire", _WIRE_SWEEP)
+def test_fsdp_compressed_matches_f32_and_stays_sharded(wire, devices):
+    """FSDP: monolithic (single-flat-bucket explicit step) + bucketed +
+    overlapped with a compressed wire — trajectory within budget AND
+    the 1/N at-rest sharding of params + moments preserved."""
+    from distributed_model_parallel_tpu.parallel.fsdp import FSDPEngine
+    from distributed_model_parallel_tpu.training.optim import AdamW
+
+    hybrid = make_mesh(MeshSpec(data=8, dcn=2))
+    _, base = _run(FSDPEngine(
+        tiny_cnn(10), AdamW(), hybrid, donate=False,
+        min_shard_elems=64,
+    ), lr=1e-3)
+    for gr in ("monolithic", "bucketed", "overlapped"):
+        ts, traj = _run(FSDPEngine(
+            tiny_cnn(10), AdamW(), hybrid, donate=False,
+            min_shard_elems=64, grad_reduction=gr, bucket_mb=0.02,
+            dcn_compression=wire,
+        ), lr=1e-3)
+        np.testing.assert_allclose(
+            traj, base, rtol=_budget(wire), err_msg=f"{gr}/{wire}"
+        )
+        big = max(
+            jax.tree_util.tree_leaves(ts.params), key=lambda l: l.size
+        )
+        assert np.prod(big.addressable_shards[0].data.shape) == (
+            big.size // 8
+        )
+        mu = max(
+            jax.tree_util.tree_leaves(ts.opt_state.mu),
+            key=lambda l: l.size,
+        )
+        assert np.prod(mu.addressable_shards[0].data.shape) == (
+            mu.size // 8
+        )
+
+
+@pytest.mark.parametrize("wire", _WIRE_SWEEP)
+def test_causal_lm_sp_compressed_matches_f32(wire, devices):
+    """The lm CLI's engine: compressed data buckets (after the 'seq'
+    psum) across all three reduction modes vs the f32 monolithic
+    control, within budget."""
+    from distributed_model_parallel_tpu.models.gpt import GPTConfig
+    from distributed_model_parallel_tpu.parallel.sequence_parallel import (
+        CausalLMSequenceParallelEngine,
+    )
+    from distributed_model_parallel_tpu.training.optim import AdamW
+
+    cfg = GPTConfig(
+        vocab_size=64, dim=32, num_layers=2, num_heads=4, ffn_dim=64,
+        max_position=32, dropout_rate=0.0, pad_token_id=0,
+    )
+    ids = np.random.RandomState(0).randint(
+        1, 64, size=(8, 32)
+    ).astype(np.int32)
+    mesh = make_mesh(MeshSpec(data=4, seq=2, dcn=2))
+
+    def run(eng):
+        ts = eng.init_state(jax.random.PRNGKey(0))
+        a, b = eng.shard_batch(ids)
+        traj = []
+        for _ in range(3):
+            ts, m = eng.train_step(ts, a, b, jnp.float32(1e-3))
+            traj.append(float(m["loss_sum"]))
+        return traj
+
+    base = run(CausalLMSequenceParallelEngine(
+        cfg, AdamW(), mesh, donate=False
+    ))
+    for gr in ("monolithic", "bucketed", "overlapped"):
+        traj = run(CausalLMSequenceParallelEngine(
+            cfg, AdamW(), mesh, donate=False, grad_reduction=gr,
+            bucket_mb=0.02, dcn_compression=wire,
+        ))
+        np.testing.assert_allclose(
+            traj, base, rtol=_budget(wire), err_msg=f"{gr}/{wire}"
+        )
+
+
+@pytest.mark.parametrize("wire", _WIRE_SWEEP)
+def test_ep_compressed_dispatch_matches_f32(wire, devices):
+    """Compressed hierarchical MoE dispatch (unfused + overlapped) vs
+    the f32 hierarchical control on the 2x4 hybrid fabric: the
+    activations cross the codec here, so the budget is the wire's, and
+    unfused == overlapped EXACTLY (same codec applications)."""
+    from distributed_model_parallel_tpu.analysis.lint import (
+        moe_classifier,
+    )
+    from distributed_model_parallel_tpu.parallel.expert_parallel import (
+        ExpertParallelEngine,
+    )
+
+    model = moe_classifier(8, dim=16)
+    mesh = make_mesh(MeshSpec(data=8, dcn=2))
+
+    def run(eng):
+        rr = np.random.RandomState(0)
+        labels = rr.randint(0, 4, size=(8,)).astype(np.int32)
+        means = np.random.RandomState(99).randn(4, 16).astype(
+            np.float32
+        )
+        x = rr.randn(8, 8, 16).astype(np.float32) * 0.5 \
+            + means[labels][:, None]
+        ts = eng.init_state(jax.random.PRNGKey(0))
+        xs, lbs = eng.shard_batch(x, labels)
+        traj = []
+        for _ in range(3):
+            ts, m = eng.train_step(ts, xs, lbs, jnp.float32(0.05))
+            traj.append(float(m["loss_sum"]) / float(m["count"]))
+        return traj
+
+    base = run(ExpertParallelEngine(
+        model, SGD(), mesh, donate=False, dispatch="hierarchical"
+    ))
+    trajs = {}
+    for overlap in (False, True):
+        trajs[overlap] = run(ExpertParallelEngine(
+            model, SGD(), mesh, donate=False, dispatch="hierarchical",
+            overlap=overlap, dcn_compression=wire,
+        ))
+        np.testing.assert_allclose(
+            trajs[overlap], base, rtol=_budget(wire)
+        )
+        assert trajs[overlap][-1] < trajs[overlap][0]
+    np.testing.assert_array_equal(trajs[False], trajs[True])
+
+
+def test_ddp_compressed_five_step_trajectory_drift(devices):
+    """The drift quantification the ISSUE asks for: 5 steps of DDP on
+    the hybrid mesh, f32 vs bf16 vs int8 wires. Drift (max relative
+    loss deviation from f32) must stay inside the documented budgets,
+    both compressed runs must still descend, and bf16 must not drift
+    MORE than the documented int8 ceiling (the codecs stay ordered by
+    their bounds)."""
+    hybrid = make_mesh(MeshSpec(data=8, dcn=2))
+
+    def run(wire):
+        eng = DDPEngine(
+            tiny_cnn(10), SGD(), hybrid, donate=False,
+            grad_reduction="bucketed", bucket_mb=0.02,
+            dcn_compression=wire,
+        )
+        return _run(eng, n_steps=5)[1]
+
+    base = run("none")
+    drift = {}
+    for wire in ("bf16", "int8"):
+        traj = run(wire)
+        drift[wire] = max(
+            abs(a - b) / abs(b) for a, b in zip(traj, base)
+        )
+        assert traj[-1] < traj[0], f"{wire} run did not descend"
+    assert drift["bf16"] <= BF16_TRAJ_RTOL, drift
+    assert drift["int8"] <= INT8_TRAJ_RTOL, drift
+
+
+# -------------------------------------------------------------- guards
+
+
+def test_engine_guards(devices):
+    """Misuse fails at construction, not an epoch in: compression on a
+    mesh with no 'dcn' axis (every engine), on the gspmd EP dispatch,
+    and unknown codec names."""
+    from distributed_model_parallel_tpu.analysis.lint import (
+        moe_classifier,
+    )
+    from distributed_model_parallel_tpu.models.gpt import GPTConfig
+    from distributed_model_parallel_tpu.parallel.expert_parallel import (
+        ExpertParallelEngine,
+    )
+    from distributed_model_parallel_tpu.parallel.fsdp import FSDPEngine
+    from distributed_model_parallel_tpu.parallel.sequence_parallel import (
+        CausalLMSequenceParallelEngine,
+    )
+
+    plain = make_mesh(MeshSpec(data=8))
+    with pytest.raises(ValueError, match="dcn"):
+        DDPEngine(tiny_cnn(10), SGD(), plain, dcn_compression="int8")
+    with pytest.raises(ValueError, match="dcn"):
+        FSDPEngine(tiny_cnn(10), SGD(), plain, dcn_compression="bf16")
+    cfg = GPTConfig(
+        vocab_size=64, dim=16, num_layers=2, num_heads=2, ffn_dim=32,
+        max_position=16, dropout_rate=0.0, pad_token_id=0,
+    )
+    with pytest.raises(ValueError, match="dcn"):
+        CausalLMSequenceParallelEngine(
+            cfg, SGD(), make_mesh(MeshSpec(data=4, seq=2)),
+            dcn_compression="int8",
+        )
+    with pytest.raises(ValueError, match="dcn"):
+        ExpertParallelEngine(
+            moe_classifier(8, dim=16), SGD(), plain,
+            dispatch="hierarchical", dcn_compression="int8",
+        )
+    with pytest.raises(ValueError, match="hierarchical"):
+        ExpertParallelEngine(
+            moe_classifier(8, dim=16), SGD(),
+            make_mesh(MeshSpec(data=8, dcn=2)),
+            dcn_compression="bf16",  # gspmd dispatch: no dcn seam
+        )
+    with pytest.raises(ValueError, match="dcn_compression"):
+        DDPEngine(
+            tiny_cnn(10), SGD(), make_mesh(MeshSpec(data=8, dcn=2)),
+            dcn_compression="fp8",
+        )
+
+
+if __name__ == "__main__":
+    raise SystemExit(pytest.main([__file__, "-v"]))
