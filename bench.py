@@ -98,12 +98,102 @@ def peak_bf16_flops(device_kind: str):
     return None
 
 
+# ---- run-metadata header (self-describing trajectory files): every
+# emitted BENCH/MULTICHIP JSON carries the git sha, jax version, mesh
+# axes (once a child built one), and backend platform it was measured
+# under, so a BENCH_r*.json is attributable without the round's logs.
+_RUN_META: dict | None = None
+_MESH_AXES: dict | None = None
+
+
+def _note_mesh(mesh) -> None:
+    """Record the measuring child's mesh axes for the run_meta header."""
+    global _MESH_AXES
+    try:
+        _MESH_AXES = {
+            str(a): int(mesh.shape[a]) for a in mesh.axis_names
+        }
+    except Exception:  # noqa: BLE001 — header is best-effort
+        pass
+
+
+def _run_meta(**extra) -> dict:
+    global _RUN_META
+    if _RUN_META is None:
+        meta = {}
+        try:
+            sha = subprocess.run(
+                ["git", "rev-parse", "--short", "HEAD"],
+                cwd=os.path.dirname(os.path.abspath(__file__)),
+                capture_output=True, text=True, timeout=5,
+            ).stdout.strip()
+            meta["git_sha"] = sha or None
+        except Exception:  # noqa: BLE001 — header is best-effort
+            meta["git_sha"] = None
+        try:
+            # Version only — importing jax.version never dials a backend.
+            from jax import version as _jax_version
+
+            meta["jax_version"] = _jax_version.__version__
+        except Exception:  # noqa: BLE001
+            meta["jax_version"] = None
+        _RUN_META = meta
+    out = dict(_RUN_META)
+    if _MESH_AXES is not None:
+        out["mesh_axes"] = _MESH_AXES
+    out.update({k: v for k, v in extra.items() if v is not None})
+    return out
+
+
+# ---- cost-engine column: where the committed ledger
+# (experiments/cost_ledger.json, tools/costgate) has a row for the
+# hlolint-matrix combo matching a sweep row's shape, the row carries
+# that combo's predicted step time. The ledger prices the LINT-sized
+# model on the modeled TPU fabrics — a structural reference column, not
+# a forecast of the CPU-measured milliseconds beside it.
+_LEDGER: dict | None = None
+
+
+def _ledger_predicted_ms(combo_name: str):
+    """The ledger combo's predicted step time in ms (float), or None
+    when the ledger or the row is absent."""
+    global _LEDGER
+    if _LEDGER is None:
+        path = os.path.join(
+            os.path.dirname(os.path.abspath(__file__)),
+            "experiments", "cost_ledger.json",
+        )
+        try:
+            with open(path) as f:
+                _LEDGER = json.load(f).get("combos", {})
+        except Exception:  # noqa: BLE001 — column is best-effort
+            _LEDGER = {}
+    row = _LEDGER.get(combo_name)
+    if row is None:
+        return None
+    return round(float(row["predicted_step_s"]) * 1e3, 6)
+
+
+def _with_predicted(row: dict, *combo_names: str) -> dict:
+    """Attach the first ledger hit among `combo_names` (the matrix
+    ships some shapes only in a model/overlap variant, so callers pass
+    the exact twin first and its variants as fallbacks)."""
+    for name in combo_names:
+        ms = _ledger_predicted_ms(name)
+        if ms is not None:
+            row["predicted_ms"] = ms
+            row["predicted_combo"] = name
+            return row
+    return row
+
+
 def emit(value: float, vs_baseline: float, **extra) -> None:
     print(json.dumps({
         "metric": METRIC,
         "value": round(value, 1),
         "unit": "images/sec",
         "vs_baseline": round(vs_baseline, 3),
+        "run_meta": _run_meta(platform=extra.get("platform")),
         **extra,
     }), flush=True)
 
@@ -241,6 +331,7 @@ def _measure(model_name: str, batch: int, dtype_name: str,
     builder, hw = _bench_models()[model_name]
     cdt = {"bfloat16": jnp.bfloat16, "float32": None}[dtype_name]
     mesh = make_mesh(MeshSpec(data=-1))
+    _note_mesh(mesh)
     engine = DataParallelEngine(
         model=builder(), optimizer=SGD(), mesh=mesh, compute_dtype=cdt,
     )
@@ -507,6 +598,7 @@ def run_child_scaling(max_devices: int, model_name: str = "tinycnn",
     rows = []
     for n in sizes:
         mesh = make_mesh(MeshSpec(data=n), devices=devices[:n])
+        _note_mesh(mesh)
         engine = DDPEngine(model=builder(), optimizer=SGD(), mesh=mesh)
         state = engine.init_state(jax.random.PRNGKey(0))
         batch = per_chip_batch * n
@@ -522,7 +614,10 @@ def run_child_scaling(max_devices: int, model_name: str = "tinycnn",
         _sync(state)
         dt = time.perf_counter() - t0
         per_chip = batch * iters / dt / n
-        rows.append({"chips": n, "img_per_sec_per_chip": round(per_chip, 1)})
+        rows.append(_with_predicted(
+            {"chips": n, "img_per_sec_per_chip": round(per_chip, 1)},
+            f"ddp/S{n}/monolithic",
+        ))
         # Per-leg partial line (VERDICT r5 ask): a relay wedge mid-sweep
         # keeps the sizes that already measured — the parent drains
         # stdout and folds these into its diagnostic JSON.
@@ -532,7 +627,10 @@ def run_child_scaling(max_devices: int, model_name: str = "tinycnn",
         r["weak_scaling_efficiency"] = round(
             r["img_per_sec_per_chip"] / base, 3
         )
-    out = {"scaling": rows}
+    out = {
+        "scaling": rows,
+        "run_meta": _run_meta(platform=jax.devices()[0].platform),
+    }
     if jax.devices()[0].platform == "cpu":
         out["note"] = (
             "virtual CPU devices share one host core, so per-chip "
@@ -611,6 +709,7 @@ def run_child_cm(max_devices: int, platform: str = "cpu") -> None:
     rows = []
     for size in sizes:
         mesh = Mesh(np.array(devices[:size]), ("model",))
+        _note_mesh(mesh)
         x = jnp.asarray(
             0.1 * rng.randn(batch, 32 * size, dmodel), jnp.float32
         )
@@ -654,6 +753,12 @@ def run_child_cm(max_devices: int, platform: str = "cpu") -> None:
         row["step_speedup"] = round(
             row["step_naive_ms"] / max(row["step_overlapped_ms"], 1e-9), 3
         )
+        # Ledger column: the ag+rs op-level kernel pair this row times.
+        ag = _ledger_predicted_ms(f"cm_ag/S{size}")
+        rs = _ledger_predicted_ms(f"cm_rs/S{size}")
+        if ag is not None and rs is not None:
+            row["predicted_ms"] = round(ag + rs, 6)
+            row["predicted_combo"] = f"cm_ag+cm_rs/S{size}"
         rows.append(row)
         log(f"S={size}: fwd {row['fwd_naive_ms']}ms naive vs "
             f"{row['fwd_overlapped_ms']}ms overlapped")
@@ -667,6 +772,7 @@ def run_child_cm(max_devices: int, platform: str = "cpu") -> None:
         "device_kind": jax.devices()[0].device_kind,
         "shapes": {"batch": batch, "seq_per_shard": 32,
                    "d_model": dmodel, "d_ff": dff},
+        "run_meta": _run_meta(platform=jax.devices()[0].platform),
     }
     if jax.devices()[0].platform == "cpu":
         out["note"] = (
@@ -887,6 +993,9 @@ def run_child_reducer(max_devices: int, platform: str = "cpu") -> None:
         row["overlapped_speedup"] = round(
             row["bwd_bucketed_ms"] / max(row["overlapped_ms"], 1e-9), 3
         )
+        # Ledger column keyed on the hierarchical leg's lint-matrix
+        # twin (the 2 x S/2 dcn x ici bucketed reducer).
+        _with_predicted(row, f"ddp/S{size}/dcn2/bucketed")
         rows.append(row)
         log(f"S={size}: naive {row['naive_ms']}ms, bucketed "
             f"{row['bucketed_ms']}ms, hierarchical "
@@ -917,6 +1026,12 @@ def run_child_reducer(max_devices: int, platform: str = "cpu") -> None:
             wrow["hierarchical_speedup"] = round(
                 row["naive_ms"] / max(wrow["hierarchical_ms"], 1e-9), 3
             )
+            _with_predicted(
+                wrow,
+                f"ddp/S{size}/dcn2/bucketed/wire-{wire}",
+                f"ddp/S{size}/dcn2/bucketed/wire-{wire}/tinycnn",
+                f"ddp/S{size}/dcn2/overlapped/wire-{wire}",
+            )
             rows.append(wrow)
             log(f"S={size} wire={wire}: hierarchical "
                 f"{wrow['hierarchical_ms']}ms")
@@ -938,6 +1053,7 @@ def run_child_reducer(max_devices: int, platform: str = "cpu") -> None:
             "buckets; overlapped = stagewise eager firing)"
         ),
     }
+    out["run_meta"] = _run_meta(platform=jax.devices()[0].platform)
     if jax.devices()[0].platform == "cpu":
         out["note"] = (
             "virtual CPU devices serialize the rings onto one core, so "
@@ -1054,6 +1170,7 @@ def run_child_moe(max_devices: int, platform: str = "cpu") -> None:
             np.array(devices[:size]).reshape(2, size // 2),
             ("dcn", "ici"),
         )
+        _note_mesh(hier_mesh)
 
         def hier_body(xl, wl, overlap, wire="none"):
             return exchanged_expert_ffn(
@@ -1081,6 +1198,13 @@ def run_child_moe(max_devices: int, platform: str = "cpu") -> None:
         )
         row["overlapped_speedup"] = round(
             row["flat_ms"] / max(row["overlapped_ms"], 1e-9), 3
+        )
+        # Ledger column: the hybrid hierarchical-dispatch twin (the
+        # matrix ships some sizes only in the overlapped variant).
+        _with_predicted(
+            row,
+            f"ep/S{size}/dcn2/hierarchical",
+            f"ep/S{size}/dcn2/hierarchical/ov",
         )
         rows.append(row)
         log(f"S={size}: flat {row['flat_ms']}ms, hierarchical "
@@ -1114,6 +1238,11 @@ def run_child_moe(max_devices: int, platform: str = "cpu") -> None:
             wrow["overlapped_speedup"] = round(
                 row["flat_ms"] / max(wrow["overlapped_ms"], 1e-9), 3
             )
+            _with_predicted(
+                wrow,
+                f"ep/S{size}/dcn2/hierarchical/wire-{wire}",
+                f"ep/S{size}/dcn2/hierarchical/ov/wire-{wire}",
+            )
             rows.append(wrow)
             log(f"S={size} wire={wire}: hierarchical "
                 f"{wrow['hierarchical_ms']}ms, overlapped "
@@ -1135,6 +1264,7 @@ def run_child_moe(max_devices: int, platform: str = "cpu") -> None:
             "hierarchical/overlapped = the moe_ring two-level path"
         ),
     }
+    out["run_meta"] = _run_meta(platform=jax.devices()[0].platform)
     if jax.devices()[0].platform == "cpu":
         out["note"] = (
             "virtual CPU devices serialize the rings onto one core, so "
@@ -1205,6 +1335,7 @@ def run_child_serving(max_devices: int, platform: str = "cpu") -> None:
                 seq=size if layout == "sp" else 1,
             )
             mesh = make_mesh(spec, devices=devices[:size])
+            _note_mesh(mesh)
         eng = ServingEngine(
             cfg, mesh, layout=layout, num_slots=num_slots,
             max_len=max_len, prefill_len=p_len, collective_matmul=cm,
@@ -1260,6 +1391,12 @@ def run_child_serving(max_devices: int, platform: str = "cpu") -> None:
                 num_slots * len(dc) / (dc.sum() / 1e3), 1
             ),
         }
+        if layout == "tp":
+            # The lint matrix's serving combos are the tp decode step
+            # (declarative and opted-in rings).
+            _with_predicted(
+                row, f"serve/S{size}" + ("/cm" if cm else "")
+            )
         rows.append(row)
         log(f"{row['layout']} S={size}: prefill p50 "
             f"{row['prefill_p50_ms']}ms, decode p50 "
@@ -1279,6 +1416,7 @@ def run_child_serving(max_devices: int, platform: str = "cpu") -> None:
         "num_slots": num_slots,
         "prefill_len": p_len,
         "max_len": max_len,
+        "run_meta": _run_meta(platform=jax.devices()[0].platform),
     }
     if jax.devices()[0].platform == "cpu":
         out["note"] = (
@@ -1349,6 +1487,7 @@ def run_child_checkpoint(max_devices: int, platform: str = "cpu") -> None:
     if size % 2:
         size -= 1
     mesh = make_mesh(MeshSpec(data=size), devices=devices[:size])
+    _note_mesh(mesh)
     # A few-MB MLP so the file I/O is measurable without drowning the
     # CPU harness (SGD momentum doubles the state bytes).
     model = L.sequential(
@@ -1431,6 +1570,7 @@ def run_child_checkpoint(max_devices: int, platform: str = "cpu") -> None:
         "axis_size": size,
         "state_mb": round(state_mb, 2),
         "iters_per_mode": iters,
+        "run_meta": _run_meta(platform=jax.devices()[0].platform),
     }
     if jax.devices()[0].platform == "cpu":
         out["note"] = (

@@ -882,11 +882,19 @@ _BUILDERS: dict = {
 }
 
 
-def lint_combo(combo: Combo, devices=None) -> LintReport:
+def lower_combo(combo: Combo, devices=None):
+    """Lower one combo through its builder: (LintTarget, compiled HLO
+    text, mesh). Shared by the rule driver (`lint_combo`) and the cost
+    engine (`observability/cost.combo_cost`) so both judge the SAME
+    lowered program."""
     import jax
 
     devices = list(devices if devices is not None else jax.devices())
-    target, hlo, mesh = _BUILDERS[combo.engine](combo, devices)
+    return _BUILDERS[combo.engine](combo, devices)
+
+
+def lint_combo(combo: Combo, devices=None) -> LintReport:
+    target, hlo, mesh = lower_combo(combo, devices)
     mesh_model = MeshModel.from_mesh(mesh)
     ctx = LintContext.build(target, hlo, mesh_model)
     return LintReport(

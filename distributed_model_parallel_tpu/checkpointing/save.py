@@ -104,33 +104,44 @@ def save_sharded(
 
     # ---- plan + snapshot (main thread): identical plan on every
     # process; data copied host-side only for chunks this process owns.
+    # `ckpt_snapshot` is the span the step path pays even under a
+    # writer (observability/trace.py; the I/O half records
+    # `ckpt_background_write` on the writer thread).
+    from distributed_model_parallel_tpu.observability.trace import (
+        get_tracer,
+    )
+
     writing_processes: list[int] = []
     proc_to_file: dict[int, int] = {}
     records: dict[str, LeafRecord] = {}
     my_arrays: dict[str, Any] = {}
-    for path, leaf in leaves_with_paths:
-        key = _path_str(path)
-        chunks = []
-        for ordinal, pc in enumerate(plan_leaf_chunks(leaf)):
-            if pc.owner_process not in proc_to_file:
-                proc_to_file[pc.owner_process] = len(writing_processes)
-                writing_processes.append(pc.owner_process)
-            npz_key = f"{key}::{ordinal}"
-            chunks.append(Chunk(
-                file=proc_to_file[pc.owner_process],
-                key=npz_key,
-                start=pc.start,
-                shape=pc.shape,
-            ))
-            data = local_chunk_data(leaf, pc)
-            if data is not None:
-                my_arrays[npz_key] = data
-        records[key] = LeafRecord(
-            shape=tuple(int(d) for d in getattr(leaf, "shape", ())),
-            dtype=_dtype_str(leaf),
-            spec=leaf_spec_json(leaf),
-            chunks=chunks,
-        )
+    with get_tracer().span("ckpt_snapshot", snapshot=name,
+                           save_id=save_id):
+        for path, leaf in leaves_with_paths:
+            key = _path_str(path)
+            chunks = []
+            for ordinal, pc in enumerate(plan_leaf_chunks(leaf)):
+                if pc.owner_process not in proc_to_file:
+                    proc_to_file[pc.owner_process] = len(
+                        writing_processes
+                    )
+                    writing_processes.append(pc.owner_process)
+                npz_key = f"{key}::{ordinal}"
+                chunks.append(Chunk(
+                    file=proc_to_file[pc.owner_process],
+                    key=npz_key,
+                    start=pc.start,
+                    shape=pc.shape,
+                ))
+                data = local_chunk_data(leaf, pc)
+                if data is not None:
+                    my_arrays[npz_key] = data
+            records[key] = LeafRecord(
+                shape=tuple(int(d) for d in getattr(leaf, "shape", ())),
+                dtype=_dtype_str(leaf),
+                spec=leaf_spec_json(leaf),
+                chunks=chunks,
+            )
     shard_files = [
         shard_file_name(name, save_id, p) for p in writing_processes
     ]

@@ -95,13 +95,25 @@ class AsyncCheckpointer:
             self._thread.start()
 
     def _run(self) -> None:
+        # Local import keeps this module's surface numpy-only for the
+        # monkeypatching tests; the tracer itself is stdlib-only.
+        from distributed_model_parallel_tpu.observability.trace import (
+            get_tracer,
+        )
+
         while True:
             item = self._queue.get()
             if item is None:
                 return
             job, handle = item
             try:
-                job()
+                # The I/O half of a save, on THIS thread — the span the
+                # Chrome trace shows running beside the main loop's
+                # steps (the step path only paid ckpt_snapshot).
+                with get_tracer().span(
+                    "ckpt_background_write", path=handle.path
+                ):
+                    job()
                 handle._finish(None)
             except BaseException as e:  # noqa: BLE001 — stored, re-raised
                 # Store the checkpointer-level error BEFORE publishing

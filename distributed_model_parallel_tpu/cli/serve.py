@@ -86,6 +86,13 @@ def build_parser() -> argparse.ArgumentParser:
                    help="cache positions per slot (prompt + generated)")
     p.add_argument("--prefill-len", default=64, type=int,
                    help="padded prompt length (one prefill compile)")
+    p.add_argument("--trace-out", default=None, metavar="PATH",
+                   help="dump a Chrome trace_event JSON of the run "
+                        "(per-request admission/prefill/decode spans, "
+                        "per-step batch-occupancy counters — "
+                        "observability/trace.py; open in "
+                        "chrome://tracing or Perfetto). Fails fast if "
+                        "PATH's directory does not exist.")
     # Synthetic trace.
     p.add_argument("--num-requests", default=16, type=int)
     p.add_argument("--prompt-len-min", default=4, type=int)
@@ -176,6 +183,17 @@ def _checkpoint_guard(directory: str, name: str, cfg) -> None:
 def main(argv=None) -> dict:
     args = build_parser().parse_args(argv)
     check_serving_args(args)
+    if args.trace_out:
+        # Fail BEFORE any engine compiles: a mistyped directory must
+        # not surface as a lost trace after the whole run.
+        import os
+
+        trace_dir = os.path.dirname(os.path.abspath(args.trace_out))
+        if not os.path.isdir(trace_dir):
+            raise SystemExit(
+                f"--trace-out {args.trace_out}: directory "
+                f"{trace_dir} does not exist"
+            )
     if args.prompt_len_min < 1 or args.prompt_len_max < args.prompt_len_min:
         raise SystemExit(
             f"--prompt-len-min/max must satisfy 1 <= min <= max, got "
@@ -267,8 +285,18 @@ def main(argv=None) -> dict:
     else:
         params = engine.init_params(jax.random.PRNGKey(args.seed))
     requests = synthetic_trace(args)
+    if args.trace_out:
+        from distributed_model_parallel_tpu.observability import trace
+
+        trace.enable()
     sched = engine.run(params, requests)
     report = sched.latency_report()
+    if args.trace_out and jax.process_index() == 0:
+        from distributed_model_parallel_tpu.observability import trace
+
+        trace.get_tracer().export(args.trace_out)
+        print(f"==> wrote Chrome trace to {args.trace_out}",
+              flush=True)
     per_request = [
         {
             "rid": f.rid,

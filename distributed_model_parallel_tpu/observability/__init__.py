@@ -1,0 +1,21 @@
+"""Observability: the span tracer (`trace.py` — host-side runtime
+timeline, Chrome trace export) and the static cost engine (`cost.py` —
+shared alpha-beta constants, closed-form composition formulas, and the
+per-combo predictor `tools/costgate` gates against
+`experiments/cost_ledger.json`). INTERNALS.md §13."""
+
+from distributed_model_parallel_tpu.observability.trace import (  # noqa: F401
+    Tracer,
+    disable,
+    enable,
+    get_tracer,
+    set_tracer,
+)
+
+__all__ = [
+    "Tracer",
+    "disable",
+    "enable",
+    "get_tracer",
+    "set_tracer",
+]

@@ -1,0 +1,313 @@
+"""Static alpha-beta cost engine over classified collectives.
+
+ONE home for the per-fabric alpha/beta constants (previously private to
+`experiments/scaling64.py` — Narayanan et al. SC'21 compose exactly
+this model across fabrics) plus two layers on top of them:
+
+1. **Closed-form composition helpers** — the ring / two-level /
+   all-to-all formulas `experiments/scaling64.py` §3a–§3d derive by
+   hand, as functions. scaling64 now imports the constants from here
+   and ASSERTS its hand-derived rows against these functions within 1%,
+   so the prose model and the checked one can never silently drift.
+
+2. **The HLO walker** (`predict_collectives` / `combo_cost`) — prices
+   every collective the lint matrix already classified
+   (`analysis/collectives.py`: kind, payload bytes, crossed axes,
+   ring-vs-monolithic) with a per-kind alpha-beta formula on the fabric
+   it crosses, and sums to a per-combo predicted per-step comm time.
+   `tools/costgate` compares those predictions against the committed
+   ledger (`experiments/cost_ledger.json`) and fails CI — like a lint
+   violation — when a combo's predicted step time worsens beyond
+   tolerance or a new combo ships with no ledger row.
+
+Caveats, stated once: the walker prices the program the CPU test
+backend compiled. That backend float-normalizes bf16 collectives to
+f32, so compiled-HLO payload bytes are the F32 envelope (the wire-dtype
+contract lives in hlolint's trace-level rule `dcn-compressed-payload`);
+and the prediction is COMM time on the modeled TPU fabrics — there is
+no compute term for the lint models. Both are fine for the gate's
+purpose: the number is a deterministic function of the lowered program,
+so a regression in what the program asks the network for moves it.
+
+No jax at module level (the closed-form layer and the ledger tooling
+must import without a backend); the walker's heavy imports are
+function-local, the `analysis` imports are jax-free by that package's
+own contract.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, Iterable, Optional, Sequence
+
+from distributed_model_parallel_tpu.analysis.collectives import (
+    ClassifiedCollective,
+    MeshModel,
+)
+
+# ------------------------------------------------- per-fabric constants
+#
+# The SINGLE source of truth (formerly scaling64.py's private block;
+# provenance unchanged):
+#
+# Public TPU v5e interconnect: 2D torus, 4 ICI links/chip at 100 GB/s
+# per direction aggregate ~400 GB/s/chip; a ring along one torus axis
+# sees one link pair. Conservative effective bandwidth:
+BW_ICI_EFFECTIVE = 100e9  # bytes/s usable per ring direction
+# Per-hop launch/latency cost of one collective step (alpha; ~1 us is
+# the public order of magnitude for one ICI hop + kernel launch).
+ALPHA_HOP_S = 1e-6
+# Cross-slice (data-center network) effective bandwidth is an order of
+# magnitude below ICI — public multislice numbers put per-chip DCN
+# throughput in the tens of GB/s aggregate per slice; conservative:
+BW_DCN_EFFECTIVE = 25e9  # bytes/s usable across the slice boundary
+# Cross-slice hop latency: DCN is a routed network, not a torus link.
+ALPHA_DCN_HOP_S = 10e-6
+
+# Wire itemsize per `dcn_compression` mode (`ops/wire_codec.py`): what
+# one element of a compressed cross-slice payload costs on the wire.
+WIRE_ITEMSIZE = {"none": 4, "f32": 4, "bf16": 2, "int8": 1}
+
+#: Every constant the predictions depend on, by name — recorded in the
+#: ledger so `tools/costgate` can refuse to compare predictions made
+#: under different physics.
+CONSTANTS: Dict[str, float] = {
+    "bw_ici_effective_bytes_per_s": BW_ICI_EFFECTIVE,
+    "bw_dcn_effective_bytes_per_s": BW_DCN_EFFECTIVE,
+    "alpha_hop_s": ALPHA_HOP_S,
+    "alpha_dcn_hop_s": ALPHA_DCN_HOP_S,
+}
+
+
+@dataclasses.dataclass(frozen=True)
+class Fabric:
+    """One link class in the alpha-beta model."""
+
+    name: str
+    alpha_s: float
+    bw_bytes_per_s: float
+
+
+ICI = Fabric("ici", ALPHA_HOP_S, BW_ICI_EFFECTIVE)
+DCN = Fabric("dcn", ALPHA_DCN_HOP_S, BW_DCN_EFFECTIVE)
+
+
+# ------------------------------------------- closed-form compositions
+#
+# The scaling64 §3 formulas as functions. Arguments are payload bytes
+# (or elements for the dtype-scaled MoE wire rows), axis sizes, and the
+# bucket/op counts the alpha terms multiply.
+
+
+def ring_all_reduce_s(nbytes: float, size: int, n_ops: int = 1,
+                      bw: float = BW_ICI_EFFECTIVE,
+                      alpha: float = ALPHA_HOP_S) -> float:
+    """Single-fabric ring all-reduce (§3a): 2(S-1)/S of the payload on
+    the wire, 2(S-1) latency hops PER OP — `n_ops` counts the unfused
+    lowering's op count (1 = bucketed/fused)."""
+    if size <= 1:
+        return 0.0
+    beta = 2 * (size - 1) / size * nbytes / bw
+    return beta + n_ops * 2 * (size - 1) * alpha
+
+
+def two_level_all_reduce_s(nbytes: float, ici: int, dcn: int,
+                           n_buckets: int = 1,
+                           wire: str = "none") -> float:
+    """Hierarchical bucketed reduction over a dcn x ici fabric (§3b /
+    §3b'): ring reduce-scatter + all-gather over 'ici' at the full
+    payload, the 1/ici shard across 'dcn' — at the wire itemsize when
+    compressed (int8 adds one sidecar hop per payload hop, counted in
+    alpha; its 4-byte scale payload is noise and not priced)."""
+    wb = WIRE_ITEMSIZE[wire]
+    sidecar_hops = 1 if wire == "int8" else 0
+    beta = 2 * (ici - 1) / ici * nbytes / BW_ICI_EFFECTIVE
+    if dcn > 1:
+        beta += (
+            2 * (dcn - 1) / dcn * (nbytes / ici) * (wb / 4)
+            / BW_DCN_EFFECTIVE
+        )
+    alpha = n_buckets * (
+        2 * (ici - 1) * ALPHA_HOP_S
+        + (1 + sidecar_hops) * 2 * (dcn - 1) * ALPHA_DCN_HOP_S
+    )
+    return beta + alpha
+
+
+def flat_all_to_all_s(elems: int, itemsize: int, ici: int,
+                      dcn: int) -> float:
+    """One flat (partitioner-shaped) token exchange over the joint
+    dcn x ici fabric (§3c): (K-1)/K of the payload crosses the slice
+    boundary in (K-1)*I fragments; the intra-slice share rides ICI."""
+    x_bytes = elems * itemsize
+    n = ici * dcn
+    return (
+        (dcn - 1) / dcn * x_bytes / BW_DCN_EFFECTIVE
+        + (ici - 1) / n * x_bytes / BW_ICI_EFFECTIVE
+        + (dcn - 1) * ici * ALPHA_DCN_HOP_S
+        + (ici - 1) * ALPHA_HOP_S
+    )
+
+
+def hierarchical_all_to_all_s(elems: int, itemsize: int, ici: int,
+                              dcn: int,
+                              wire: Optional[str] = None) -> float:
+    """One two-level token exchange (§3c / §3c',
+    `ops/expert_dispatch.py`): same cross-slice bytes as the flat form
+    but in K-1 contiguous messages of the 1/ici-regrouped shard — at
+    the wire itemsize when compressed — and the intra-slice share on
+    ICI exclusively."""
+    x_bytes = elems * itemsize
+    dcn_itemsize = itemsize if wire in (None, "none") \
+        else WIRE_ITEMSIZE[wire]
+    return (
+        (dcn - 1) / dcn * (elems * dcn_itemsize) / BW_DCN_EFFECTIVE
+        + (ici - 1) / ici * x_bytes / BW_ICI_EFFECTIVE
+        + (dcn - 1) * ALPHA_DCN_HOP_S
+        + (ici - 1) * ALPHA_HOP_S
+    )
+
+
+# ------------------------------------------------------ the HLO walker
+
+
+@dataclasses.dataclass
+class CostBreakdown:
+    """Per-combo prediction: alpha/beta split and per-fabric totals.
+    `total_s` is the predicted per-step comm time — the ledger's gated
+    number."""
+
+    alpha_s: float = 0.0
+    beta_s: float = 0.0
+    n_collectives: int = 0
+    bytes_by_fabric: Dict[str, int] = dataclasses.field(
+        default_factory=dict
+    )
+    seconds_by_fabric: Dict[str, float] = dataclasses.field(
+        default_factory=dict
+    )
+
+    @property
+    def total_s(self) -> float:
+        return self.alpha_s + self.beta_s
+
+    def as_row(self) -> dict:
+        """The ledger row (stable rounding so regenerated ledgers diff
+        cleanly)."""
+        return {
+            "predicted_step_s": round(self.total_s, 9),
+            "alpha_s": round(self.alpha_s, 9),
+            "beta_s": round(self.beta_s, 9),
+            "n_collectives": self.n_collectives,
+            "bytes_by_fabric": {
+                k: int(v) for k, v in sorted(
+                    self.bytes_by_fabric.items()
+                )
+            },
+            "seconds_by_fabric": {
+                k: round(v, 9) for k, v in sorted(
+                    self.seconds_by_fabric.items()
+                )
+            },
+        }
+
+
+def _collective_cost(kind: str, nbytes: int, group: int,
+                     fabric: Fabric) -> tuple:
+    """(alpha_s, beta_s) of ONE collective instruction under the ring
+    model on its fabric. A collective-permute is one ring hop (the
+    chunked decompositions appear as S-1 separate instructions, which
+    sums back to the ring totals); the monolithic fused forms get the
+    standard ring decomposition costs."""
+    if kind == "collective-permute":
+        return fabric.alpha_s, nbytes / fabric.bw_bytes_per_s
+    if group <= 1:
+        return 0.0, 0.0
+    if kind == "all-reduce":
+        return (
+            2 * (group - 1) * fabric.alpha_s,
+            2 * (group - 1) / group * nbytes / fabric.bw_bytes_per_s,
+        )
+    # all-gather / reduce-scatter / all-to-all: one payload traversal.
+    return (
+        (group - 1) * fabric.alpha_s,
+        (group - 1) / group * nbytes / fabric.bw_bytes_per_s,
+    )
+
+
+def predict_collectives(
+    collectives: Sequence[ClassifiedCollective],
+    mesh: MeshModel,
+    dcn_axis: Optional[str] = None,
+) -> CostBreakdown:
+    """Price every classified collective and sum. Fabric assignment is
+    the mesh's: a collective whose membership crosses `dcn_axis` is
+    priced on DCN (the slow fabric gates it); everything else rides
+    ICI. Unclassifiable membership (axes=None) is conservatively priced
+    as crossing every non-trivial axis — the same worst-case answer the
+    lint rules give it."""
+    nontrivial = frozenset(
+        a for a, s in zip(mesh.axis_names, mesh.shape) if s > 1
+    )
+    out = CostBreakdown()
+    for c in collectives:
+        axes = c.axes if c.axes is not None else nontrivial
+        if not axes:
+            continue  # single-device membership: free
+        fabric = DCN if (dcn_axis is not None and dcn_axis in axes) \
+            else ICI
+        group = 1
+        for a in axes:
+            group *= mesh.size(a)
+        alpha, beta = _collective_cost(
+            c.kind, c.payload_bytes, group, fabric
+        )
+        out.alpha_s += alpha
+        out.beta_s += beta
+        out.n_collectives += 1
+        out.bytes_by_fabric[fabric.name] = (
+            out.bytes_by_fabric.get(fabric.name, 0) + c.payload_bytes
+        )
+        out.seconds_by_fabric[fabric.name] = (
+            out.seconds_by_fabric.get(fabric.name, 0.0) + alpha + beta
+        )
+    return out
+
+
+def combo_cost(combo, devices=None) -> dict:
+    """Lower ONE lint-matrix combo (reusing the lint driver's builders
+    — the same model, mesh, and compiled HLO the rules judge) and
+    return its ledger row. Heavy: compiles on the virtual mesh."""
+    from distributed_model_parallel_tpu.analysis.hlo import parse_hlo
+    from distributed_model_parallel_tpu.analysis.collectives import (
+        classify,
+    )
+    from distributed_model_parallel_tpu.analysis.lint import lower_combo
+
+    target, hlo, mesh = lower_combo(combo, devices)
+    mesh_model = MeshModel.from_mesh(mesh)
+    collectives = classify(parse_hlo(hlo), mesh_model)
+    breakdown = predict_collectives(
+        collectives, mesh_model, target.dcn_axis
+    )
+    return breakdown.as_row()
+
+
+__all__ = [
+    "ALPHA_DCN_HOP_S",
+    "ALPHA_HOP_S",
+    "BW_DCN_EFFECTIVE",
+    "BW_ICI_EFFECTIVE",
+    "CONSTANTS",
+    "CostBreakdown",
+    "DCN",
+    "Fabric",
+    "ICI",
+    "WIRE_ITEMSIZE",
+    "combo_cost",
+    "flat_all_to_all_s",
+    "hierarchical_all_to_all_s",
+    "predict_collectives",
+    "ring_all_reduce_s",
+    "two_level_all_reduce_s",
+]

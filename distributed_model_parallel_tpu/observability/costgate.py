@@ -1,0 +1,313 @@
+"""costgate — the perf-regression gate over the lint matrix.
+
+`tools/costgate` is the CLI. For each engine x mode combo the hlolint
+matrix defines (`analysis/lint.full_matrix`), the cost engine predicts
+per-step comm time from the combo's OWN compiled HLO
+(`observability/cost.combo_cost`); this module compares those
+predictions against the committed ledger
+(`experiments/cost_ledger.json`) and fails — like a lint violation,
+with the combo NAMED — when:
+
+  * a combo's predicted step time worsens beyond tolerance vs its
+    ledger row (a perf regression in what the program asks the network
+    for),
+  * a combo in the matrix has NO ledger row (a new engine x mode combo
+    shipped without committing its cost baseline),
+  * the ledger was generated under different alpha/beta constants
+    (comparisons across physics are meaningless — regenerate).
+
+Exit codes: 0 clean; 4 gate failure (tools/tier1.sh's costgate
+pre-gate keys on it; 2/3 are the collection and hlolint pre-gates'); 2
+usage errors.
+
+Modes:
+  --pregate   lower only the tier-1 cut (`pregate_matrix`, seconds) and
+              additionally name-check EVERY full-matrix combo against
+              the ledger (no lowering needed for the name check).
+  --update    regenerate rows and (re)write the ledger: the full matrix
+              by default, or a merge of just the --filter/--pregate
+              subset into the existing file.
+
+Per finished combo one partial-JSON line streams out (the repo's
+established convention), then a summary object.
+
+The gate-check itself (`gate_check`) is a pure function over (ledger,
+predictions) so tests pin the regression / missing-row / tolerance
+semantics without compiling anything.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+from typing import Dict, List, Optional, Sequence
+
+from distributed_model_parallel_tpu.observability.cost import CONSTANTS
+
+DEFAULT_LEDGER = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__)
+    ))),
+    "experiments", "cost_ledger.json",
+)
+DEFAULT_TOLERANCE = 0.05  # 5% predicted-step-time headroom
+
+EXIT_GATE_FAILED = 4
+
+
+def load_ledger(path: str) -> dict:
+    with open(path) as f:
+        ledger = json.load(f)
+    if "combos" not in ledger:
+        raise ValueError(
+            f"{path}: not a cost ledger (no 'combos' key)"
+        )
+    return ledger
+
+
+def make_ledger(rows: Dict[str, dict],
+                tolerance: float = DEFAULT_TOLERANCE) -> dict:
+    return {
+        "constants": dict(CONSTANTS),
+        "tolerance": tolerance,
+        "combos": {k: rows[k] for k in sorted(rows)},
+    }
+
+
+def gate_check(
+    ledger: dict,
+    predictions: Dict[str, dict],
+    tolerance: Optional[float] = None,
+    require_rows_for: Optional[Sequence[str]] = None,
+) -> List[str]:
+    """Pure comparison: one failure string per violated contract.
+
+    `predictions` maps combo name -> row (at least `predicted_step_s`);
+    `require_rows_for` additionally name-checks combos that were NOT
+    lowered this run (the pre-gate's full-matrix coverage check)."""
+    failures: List[str] = []
+    tol = tolerance if tolerance is not None \
+        else float(ledger.get("tolerance", DEFAULT_TOLERANCE))
+    recorded = ledger.get("constants", {})
+    for key, want in CONSTANTS.items():
+        got = recorded.get(key)
+        if got != want:
+            failures.append(
+                f"constants drift: ledger has {key}={got!r}, the cost "
+                f"engine uses {want!r} — regenerate the ledger "
+                "(tools/costgate --update)"
+            )
+    combos = ledger["combos"]
+    for name in sorted(predictions):
+        row = combos.get(name)
+        pred = float(predictions[name]["predicted_step_s"])
+        if row is None:
+            failures.append(
+                f"{name}: no ledger row — a new engine x mode combo "
+                "must commit its cost baseline "
+                "(tools/costgate --update)"
+            )
+            continue
+        base = float(row["predicted_step_s"])
+        if pred > base * (1.0 + tol):
+            failures.append(
+                f"{name}: predicted step time regressed "
+                f"{base * 1e3:.4f} -> {pred * 1e3:.4f} ms "
+                f"(+{(pred / base - 1.0) * 100:.1f}%, tolerance "
+                f"{tol * 100:.0f}%)"
+            )
+    if require_rows_for:
+        for name in sorted(set(require_rows_for) - set(predictions)):
+            if name not in combos:
+                failures.append(
+                    f"{name}: no ledger row — a new engine x mode "
+                    "combo must commit its cost baseline "
+                    "(tools/costgate --update)"
+                )
+    return failures
+
+
+def _predict(combos, emit) -> Dict[str, dict]:
+    """Lower + price each combo, streaming one partial line per combo.
+    A combo that fails to LOWER is itself a gate failure (recorded as a
+    row with an 'error' key; the caller fails on it)."""
+    from distributed_model_parallel_tpu.observability.cost import (
+        combo_cost,
+    )
+
+    rows: Dict[str, dict] = {}
+    for combo in combos:
+        try:
+            row = combo_cost(combo)
+        except Exception as e:  # noqa: BLE001 — a failure IS a finding
+            emit(f"[costgate] {combo.name}: LOWERING FAILED: {e!r}")
+            rows[combo.name] = {"error": repr(e)}
+            emit(json.dumps({
+                "leg": {"name": combo.name, "error": repr(e)},
+                "partial": True,
+            }))
+            continue
+        rows[combo.name] = row
+        emit(f"[costgate] {combo.name}: predicted "
+             f"{row['predicted_step_s'] * 1e3:.4f} ms/step "
+             f"({row['n_collectives']} collectives)")
+        emit(json.dumps({
+            "leg": {
+                "name": combo.name,
+                "predicted_step_s": row["predicted_step_s"],
+                "n_collectives": row["n_collectives"],
+            },
+            "partial": True,
+        }))
+    return rows
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    import argparse
+
+    parser = argparse.ArgumentParser(
+        prog="costgate",
+        description=(
+            "Perf-regression gate: predict per-combo per-step comm "
+            "time over the hlolint matrix (alpha-beta cost engine, "
+            "INTERNALS.md section 13) and compare against the "
+            "committed ledger."
+        ),
+    )
+    parser.add_argument(
+        "--pregate", action="store_true",
+        help="tier-1 cut: lower only the pregate combos (seconds) and "
+             "name-check every full-matrix combo against the ledger",
+    )
+    parser.add_argument(
+        "--filter", default=None,
+        help="regex over combo names (e.g. 'ddp.*dcn')",
+    )
+    parser.add_argument(
+        "--update", action="store_true",
+        help="regenerate rows and write the ledger instead of gating "
+             "(full rewrite; merges into the existing file under "
+             "--filter/--pregate)",
+    )
+    parser.add_argument("--ledger", default=DEFAULT_LEDGER)
+    parser.add_argument(
+        "--tolerance", type=float, default=None,
+        help=f"override the ledger's tolerance (default "
+             f"{DEFAULT_TOLERANCE})",
+    )
+    parser.add_argument("--devices", type=int, default=8)
+    args = parser.parse_args(argv)
+
+    # Virtual CPU devices BEFORE any backend initializes (same guard as
+    # tools/hlolint: this environment preloads a TPU PJRT plugin).
+    from distributed_model_parallel_tpu.runtime.platform import force_cpu
+
+    force_cpu(args.devices)
+
+    from distributed_model_parallel_tpu.analysis.lint import (
+        full_matrix,
+        pregate_matrix,
+    )
+
+    matrix = full_matrix()
+    combos = pregate_matrix() if args.pregate else matrix
+    if args.filter:
+        import re
+
+        combos = [c for c in combos if re.search(args.filter, c.name)]
+    if not combos:
+        print("[costgate] no combos match", file=sys.stderr)
+        return 2
+    # full_matrix may repeat a name (the pre-gate twins); dedupe.
+    seen = set()
+    combos = [
+        c for c in combos
+        if not (c.name in seen or seen.add(c.name))
+    ]
+
+    subset_update = args.update and (args.pregate or args.filter) \
+        and os.path.exists(args.ledger)
+    old = load_ledger(args.ledger) if subset_update else None
+    if old is not None:
+        drifted = sorted(
+            k for k, v in CONSTANTS.items()
+            if old.get("constants", {}).get(k) != v
+        )
+        if drifted:
+            # Merging would keep the un-lowered rows at the OLD
+            # physics while stamping the ledger with the current
+            # constants — silently defeating the drift guard. A
+            # constants change requires repricing every row. Checked
+            # BEFORE any lowering so the refusal costs nothing.
+            print(
+                "[costgate] refusing subset --update: the existing "
+                f"ledger was priced under different constants "
+                f"({', '.join(drifted)}); run a FULL "
+                "`tools/costgate --update` to reprice every combo",
+                file=sys.stderr,
+            )
+            return 2
+
+    rows = _predict(combos, print)
+    errored = sorted(n for n, r in rows.items() if "error" in r)
+    rows = {n: r for n, r in rows.items() if "error" not in r}
+
+    if args.update:
+        # Tolerance precedence: explicit flag > the merged-into
+        # ledger's committed value > the default — a subset merge must
+        # not silently reset a deliberately committed tolerance.
+        tol = args.tolerance
+        if tol is None and old is not None:
+            tol = float(old.get("tolerance", DEFAULT_TOLERANCE))
+        if tol is None:
+            tol = DEFAULT_TOLERANCE
+        if old is not None:
+            merged = old["combos"]
+            merged.update(rows)
+            rows = merged
+        ledger = make_ledger(rows, tol)
+        with open(args.ledger, "w") as f:
+            json.dump(ledger, f, indent=1)
+            f.write("\n")
+        print(json.dumps({
+            "costgate": {
+                "updated": args.ledger,
+                "combos": len(ledger["combos"]),
+                "errors": len(errored),
+                "failed_targets": errored,
+            }
+        }))
+        return EXIT_GATE_FAILED if errored else 0
+
+    try:
+        ledger = load_ledger(args.ledger)
+    except (OSError, ValueError) as e:
+        print(f"[costgate] cannot read ledger: {e}", file=sys.stderr)
+        return EXIT_GATE_FAILED
+    failures = gate_check(
+        ledger, rows, args.tolerance,
+        require_rows_for=[c.name for c in matrix] if args.pregate
+        else None,
+    )
+    failures += [
+        f"{name}: LOWERING FAILED (see log above)" for name in errored
+    ]
+    for f in failures:
+        print(f"[costgate] FAIL {f}")
+    print(json.dumps({
+        "costgate": {
+            "ledger": args.ledger,
+            "gated": len(rows),
+            "name_checked": len(matrix) if args.pregate else len(rows),
+            "failures": len(failures),
+            "failed_targets": sorted(
+                {f.split(":", 1)[0] for f in failures}
+            ),
+        }
+    }))
+    return EXIT_GATE_FAILED if failures else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

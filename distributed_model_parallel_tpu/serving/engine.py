@@ -36,7 +36,6 @@ approximation.
 from __future__ import annotations
 
 import dataclasses
-import time
 from functools import partial
 from typing import Any, Optional, Sequence
 
@@ -53,6 +52,7 @@ from distributed_model_parallel_tpu.models.gpt import (
     gpt_lm,
     head_apply,
 )
+from distributed_model_parallel_tpu.observability.trace import get_tracer
 from distributed_model_parallel_tpu.ops.attention import (
     dot_product_attention,
 )
@@ -447,6 +447,7 @@ class ServingEngine:
         """Offline continuous batching: drive the request set to
         completion (greedy decoding), returning the Scheduler with its
         per-request `finished` records and `latency_report()`."""
+        tracer = get_tracer()
         sched = Scheduler(self.num_slots, self.max_len)
         for r in requests:
             if r.prompt.size > self.prefill_len:
@@ -463,11 +464,13 @@ class ServingEngine:
             while sched.can_admit():
                 seq = sched.admit()
                 ids, length = self.pad_prompt(seq.request.prompt)
-                cache, next_logits = self.prefill(
-                    params, cache, ids, length, jnp.int32(seq.slot)
-                )
-                tok = int(np.asarray(next_logits).argmax())
-                seq.t_first_token = time.perf_counter()
+                with tracer.span("prefill", rid=repr(seq.request.rid),
+                                 slot=seq.slot):
+                    cache, next_logits = self.prefill(
+                        params, cache, ids, length, jnp.int32(seq.slot)
+                    )
+                    tok = int(np.asarray(next_logits).argmax())
+                seq.t_first_token = tracer.now()
                 seq.generated.append(tok)
                 tokens[seq.slot] = tok
                 active[seq.slot] = True
@@ -477,12 +480,17 @@ class ServingEngine:
             if not active.any():
                 continue
             # One decode step for the whole mixed-position batch.
-            t0 = time.perf_counter()
-            cache, logits = self.decode_step(
-                params, cache, jnp.asarray(tokens), jnp.asarray(active)
-            )
-            logits_np = np.asarray(logits)
-            dt = time.perf_counter() - t0
+            n_active = int(active.sum())
+            t0 = tracer.now()
+            with tracer.span("decode_step", active=n_active):
+                cache, logits = self.decode_step(
+                    params, cache, jnp.asarray(tokens),
+                    jnp.asarray(active),
+                )
+                logits_np = np.asarray(logits)
+            dt = tracer.now() - t0
+            sched.record_decode_step(n_active)
+            tracer.counter("batch_occupancy", n_active)
             for slot, seq in list(sched.active.items()):
                 tok = int(logits_np[slot].argmax())
                 seq.generated.append(tok)

@@ -16,12 +16,12 @@ tokens, timing legs for the latency report). Policy experiments
 from __future__ import annotations
 
 import dataclasses
-import time
 from collections import deque
 from typing import Any, Deque, Dict, List, Optional
 
 import numpy as np
 
+from distributed_model_parallel_tpu.observability.trace import get_tracer
 from distributed_model_parallel_tpu.serving.kv_cache import SlotAllocator
 
 
@@ -95,6 +95,11 @@ class Scheduler:
         self.waiting: Deque[tuple] = deque()
         self.active: Dict[int, Sequence] = {}
         self.finished: List[FinishedSequence] = []
+        # Per-step occupancy samples (engine.run reports each decode
+        # step's active-slot count via record_decode_step): the goodput
+        # denominator — every slot-step a sequence did NOT occupy was
+        # capacity the batch paid for and wasted.
+        self.step_occupancy: List[int] = []
 
     # ------------------------------------------------------- lifecycle
 
@@ -105,7 +110,11 @@ class Scheduler:
                 f"{request.prompt.size} leaves no room to generate "
                 f"(cache max_len {self.max_len})"
             )
-        self.waiting.append((time.perf_counter(), request))
+        # Timestamps ride the tracer's clock (trace.Tracer.now):
+        # identical to time.perf_counter by default, and the only
+        # domain the request-lifecycle spans emitted at finish() may
+        # mix with — an injected test clock stays coherent end to end.
+        self.waiting.append((get_tracer().now(), request))
 
     def can_admit(self) -> bool:
         return bool(self.waiting) and self.slots.free_slots > 0
@@ -117,7 +126,7 @@ class Scheduler:
         seq = Sequence(
             request=request, slot=slot,
             t_submit=t_submit,
-            t_admit=time.perf_counter(),
+            t_admit=get_tracer().now(),
         )
         self.active[slot] = seq
         return seq
@@ -126,7 +135,7 @@ class Scheduler:
         """Evict a finished sequence and recycle its slot."""
         seq = self.active.pop(slot)
         self.slots.free(slot)
-        now = time.perf_counter()
+        now = get_tracer().now()
         fin = FinishedSequence(
             rid=seq.request.rid,
             prompt_len=int(seq.request.prompt.size),
@@ -136,7 +145,33 @@ class Scheduler:
             total_s=now - seq.t_submit,
         )
         self.finished.append(fin)
+        # Request-lifecycle spans, emitted ONCE at eviction when every
+        # leg's timestamp is known (queue = submit->admit, prefill =
+        # admit->first token, decode = first token->eviction), each
+        # request on its own named track. One branch when tracing is
+        # off (observability/trace.py).
+        tracer = get_tracer()
+        if tracer.enabled:
+            tid = tracer.track_id(f"request {seq.request.rid!r}")
+            tracer.complete(
+                "queued", seq.t_submit, seq.t_admit, tid=tid
+            )
+            tracer.complete(
+                "prefill", seq.t_admit, seq.t_first_token, tid=tid,
+                prompt_len=fin.prompt_len,
+            )
+            tracer.complete(
+                "decode", seq.t_first_token, now, tid=tid,
+                tokens=len(fin.tokens), slot=slot,
+            )
         return fin
+
+    def record_decode_step(self, n_active: int) -> None:
+        """One engine decode step's occupancy sample (engine.run calls
+        this after every mixed-position batch step; the per-token
+        latency legs already live on each Sequence, so occupancy is the
+        only new information)."""
+        self.step_occupancy.append(int(n_active))
 
     def has_work(self) -> bool:
         return bool(self.waiting) or bool(self.active)
@@ -146,7 +181,12 @@ class Scheduler:
     def latency_report(self) -> dict:
         """Aggregate tokens/sec and per-token p50/p99 over the finished
         set, split by leg (prefill = submit->first token, decode =
-        per-token step latency)."""
+        per-token step latency), plus batch-occupancy telemetry:
+        `mean_batch_occupancy` is active slots per decode step and
+        `goodput` the useful fraction of slot-steps (each active slot
+        yields exactly one token per step, so occupied/total slot-steps
+        IS tokens-out over token capacity — the continuous-batching
+        claim as a number)."""
         fins = self.finished
         decode = np.asarray(
             [t for f in fins for t in f.decode_s], np.float64
@@ -154,6 +194,7 @@ class Scheduler:
         prefill = np.asarray([f.prefill_s for f in fins], np.float64)
         n_tokens = int(sum(len(f.tokens) for f in fins))
         total = max((f.total_s for f in fins), default=0.0)
+        occ = np.asarray(self.step_occupancy, np.float64)
         out = {
             "requests": len(fins),
             "generated_tokens": n_tokens,
@@ -164,6 +205,18 @@ class Scheduler:
             "prefill_p99_ms": _pct(prefill, 99),
             "decode_p50_ms": _pct(decode, 50),
             "decode_p99_ms": _pct(decode, 99),
+            "decode_steps": int(occ.size),
+            "mean_batch_occupancy": (
+                round(float(occ.mean()), 3) if occ.size else None
+            ),
+            "goodput": (
+                round(
+                    float(occ.sum())
+                    / (occ.size * self.slots.num_slots),
+                    4,
+                )
+                if occ.size else None
+            ),
         }
         return out
 

@@ -40,6 +40,7 @@ from distributed_model_parallel_tpu.checkpointing import (
     restore_checkpoint,
     save_sharded,
 )
+from distributed_model_parallel_tpu.observability.trace import get_tracer
 from distributed_model_parallel_tpu.runtime.dist import is_primary
 from distributed_model_parallel_tpu.training.checkpoint import (
     newest_checkpoint_name,
@@ -208,6 +209,13 @@ class Trainer:
 
     def train_epoch(self, epoch: int) -> EpochStats:
         cfg = self.config
+        # Host-phase spans (observability/trace.py; off by default —
+        # one branch per site): fetch = host load + device placement,
+        # step = the dispatch call (enqueue under async dispatch),
+        # sync = the value-fetch fences where device time surfaces,
+        # checkpoint_blocked = how long a save holds this loop
+        # (_write_checkpoint).
+        tracer = get_tracer()
         lr = jnp.asarray(self.lr_fn(epoch), jnp.float32)
         if hasattr(self.train_loader, "set_epoch"):
             # Re-seed the per-epoch shuffle + augmentation RNG (the torch
@@ -274,10 +282,13 @@ class Trainer:
                 want = min(k, cfg.steps_per_epoch - n_done)
                 if want <= 0:
                     return []
-            t0 = time.perf_counter()
-            host_batches = group_batches(it, want)
-            data_time += time.perf_counter() - t0
-            return [self.engine.shard_batch(*b) for b in host_batches]
+            with tracer.span("fetch", want=want):
+                t0 = time.perf_counter()
+                host_batches = group_batches(it, want)
+                data_time += time.perf_counter() - t0
+                return [
+                    self.engine.shard_batch(*b) for b in host_batches
+                ]
 
         epoch_start = time.perf_counter()
         placed = fetch_group(0)
@@ -294,25 +305,29 @@ class Trainer:
                 jax.block_until_ready(self.state)  # trace excludes backlog
                 jax.profiler.start_trace(cfg.profile_dir)
                 profiling = True
-            if len(placed) == k and k > 1:
-                # One dispatch, k steps (trajectory matches the per-step
-                # path to numerical tolerance — tests/test_trainer.py).
-                if self._multi is None:
-                    self._multi = compile_multi_step(self.engine, k)
-                self.state, metrics = self._multi(
-                    self.state, tuple(placed), lr
-                )
-            else:
-                metrics = None
-                for b in placed:
-                    self.state, m_i = self.engine.train_step(
-                        self.state, *b, lr
+            with tracer.span("step", n=len(placed)):
+                if len(placed) == k and k > 1:
+                    # One dispatch, k steps (trajectory matches the
+                    # per-step path to numerical tolerance —
+                    # tests/test_trainer.py).
+                    if self._multi is None:
+                        self._multi = compile_multi_step(self.engine, k)
+                    self.state, metrics = self._multi(
+                        self.state, tuple(placed), lr
                     )
-                    metrics = (
-                        m_i
-                        if metrics is None
-                        else jax.tree_util.tree_map(jnp.add, metrics, m_i)
-                    )
+                else:
+                    metrics = None
+                    for b in placed:
+                        self.state, m_i = self.engine.train_step(
+                            self.state, *b, lr
+                        )
+                        metrics = (
+                            m_i
+                            if metrics is None
+                            else jax.tree_util.tree_map(
+                                jnp.add, metrics, m_i
+                            )
+                        )
             prev = n_batches
             n_batches += len(placed)
             # One-deep device prefetch: the dispatch above returned at
@@ -338,7 +353,8 @@ class Trainer:
             if cfg.print_freq and (
                 n_batches // cfg.print_freq > prev // cfg.print_freq
             ):
-                m = jax.device_get(metrics)  # fences this dispatch
+                with tracer.span("sync"):
+                    m = jax.device_get(metrics)  # fences this dispatch
                 self._log_print(
                     f"Epoch: [{epoch}]"
                     f"[{n_batches}/{n_avail if n_avail is not None else '?'}]"
@@ -351,7 +367,8 @@ class Trainer:
         # bench._sync), but fetching the summed metrics' bytes cannot
         # complete before every step that fed the sum has executed.
         if sums is not None:
-            sums = jax.device_get(sums)
+            with tracer.span("sync", epoch=epoch):
+                sums = jax.device_get(sums)
         if profiling:  # epoch ended inside the capture window
             jax.profiler.stop_trace()
             self._profiled = True
@@ -485,21 +502,30 @@ class Trainer:
 
     def _write_checkpoint(self, payload, name: str, epoch: int) -> None:
         cfg = self.config
-        if cfg.checkpoint_format == "legacy":
-            save_checkpoint(
+        # checkpoint_blocked spans the time this save holds the epoch
+        # loop: the whole write for sync formats, only the device->host
+        # snapshot under async_save (the writer thread records its own
+        # ckpt_background_write span — checkpointing/writer.py).
+        with get_tracer().span(
+            "checkpoint_blocked", snapshot=name, epoch=epoch,
+            format=cfg.checkpoint_format,
+        ):
+            if cfg.checkpoint_format == "legacy":
+                save_checkpoint(
+                    cfg.checkpoint_dir, payload, acc=self.best_acc,
+                    epoch=epoch, name=name, extra=cfg.checkpoint_extra,
+                )
+                return
+            if self._ckpt_writer is not None:
+                # Surface an earlier epoch's failed background write
+                # BEFORE starting a new one (checkpointing/writer.py
+                # contract).
+                self._ckpt_writer.check()
+            save_sharded(
                 cfg.checkpoint_dir, payload, acc=self.best_acc,
                 epoch=epoch, name=name, extra=cfg.checkpoint_extra,
+                writer=self._ckpt_writer,
             )
-            return
-        if self._ckpt_writer is not None:
-            # Surface an earlier epoch's failed background write BEFORE
-            # starting a new one (checkpointing/writer.py contract).
-            self._ckpt_writer.check()
-        save_sharded(
-            cfg.checkpoint_dir, payload, acc=self.best_acc,
-            epoch=epoch, name=name, extra=cfg.checkpoint_extra,
-            writer=self._ckpt_writer,
-        )
 
     def _to_canonical(self, state):
         """Checkpoints are written in the engine's layout-independent

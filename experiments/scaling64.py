@@ -45,6 +45,7 @@ import numpy as np  # noqa: E402
 
 from distributed_model_parallel_tpu.models.resnet import resnet50  # noqa: E402
 from distributed_model_parallel_tpu.models.tinycnn import tiny_cnn  # noqa: E402
+from distributed_model_parallel_tpu.observability import cost  # noqa: E402
 from distributed_model_parallel_tpu.parallel.data_parallel import (  # noqa: E402
     DDPEngine,
 )
@@ -60,25 +61,19 @@ PER_CHIP_BATCH = 256
 # Measured on the one real chip (BENCH_r04 / RESULTS.md §1): ResNet-50
 # bs256 bf16, 2489 img/s/chip -> 0.1029 s/step, MFU 0.30.
 MEASURED_STEP_S = 256 / 2489.0
-# Public TPU v5e interconnect: 2D torus, 4 ICI links/chip at 100 GB/s
-# per direction aggregate ~400 GB/s/chip; the ring all-reduce along one
-# torus axis sees one link pair. Conservative effective bandwidth:
-BW_ICI_EFFECTIVE = 100e9  # bytes/s usable per ring direction
-# Per-hop launch/latency cost of one collective step (alpha in the
-# alpha-beta model; ~1 us is the public order of magnitude for one ICI
-# hop + kernel launch). Only matters when the lowering keeps many small
-# unfused all-reduces — which is exactly what ResNet-50's own 64-way
-# compile shows on this backend (step 2).
-ALPHA_HOP_S = 1e-6
+# Per-fabric alpha/beta constants: ONE home, shared with the static
+# cost engine (`observability/cost.py` — provenance documented there),
+# so this script's hand-derived rows and the checked `tools/costgate`
+# ledger can never drift apart. Each §3 row below is ASSERTED against
+# the cost engine's closed-form prediction within 1%
+# (`_assert_cost_engine_agrees`).
+BW_ICI_EFFECTIVE = cost.BW_ICI_EFFECTIVE
+ALPHA_HOP_S = cost.ALPHA_HOP_S
+BW_DCN_EFFECTIVE = cost.BW_DCN_EFFECTIVE
+ALPHA_DCN_HOP_S = cost.ALPHA_DCN_HOP_S
 # Two-level (dcn × ici) hierarchy for the bucketed reducer
 # (`ops/grad_reduction.py`): a 64-chip job as 2 slices × 32 chips.
-# Cross-slice (data-center network) effective bandwidth is an order of
-# magnitude below ICI — public multislice numbers put per-chip DCN
-# throughput in the tens of GB/s aggregate per slice; conservative:
 DCN_SLICES = 2
-BW_DCN_EFFECTIVE = 25e9  # bytes/s usable across the slice boundary
-# Cross-slice hop latency: DCN is a routed network, not a torus link.
-ALPHA_DCN_HOP_S = 10e-6
 BUCKET_MB = 25.0  # the reducer's default bucket_cap_mb
 # MoE dispatch (step 3c): one routed layer's token exchange, sized for
 # a GPT-MoE block — per-chip token load, model dim, top-k routing with
@@ -145,6 +140,18 @@ def stablehlo_all_reduce_bytes(text):
                 nelems *= int(d)
         total_bytes += nelems * dt_bytes.get(dims[-1], 4)
     return n_ops, total_bytes
+
+
+def _assert_cost_engine_agrees(label, hand_s, engine_s):
+    """The drift tripwire: a §3 row's hand arithmetic and the cost
+    engine's closed-form prediction must agree within 1% — edit one
+    without the other and this script fails, not the prose."""
+    if abs(hand_s - engine_s) > 0.01 * max(abs(hand_s), 1e-12):
+        raise AssertionError(
+            f"{label}: hand-derived {hand_s:.6e}s disagrees with the "
+            f"cost engine's {engine_s:.6e}s by more than 1% — "
+            "observability/cost.py and scaling64.py drifted"
+        )
 
 
 def main():
@@ -232,6 +239,14 @@ def main():
           f"{eff_no_overlap:.3f} (no overlap, as lowered) .. "
           f"{eff_overlap:.3f} (full overlap); "
           f"{eff_bucketed:.3f} (no overlap, bucketed)")
+    _assert_cost_engine_agrees(
+        "ring all-reduce (as lowered)", comm_s,
+        cost.ring_all_reduce_s(opt_ar_bytes, N, n_ops=n_opt_ar),
+    )
+    _assert_cost_engine_agrees(
+        "ring all-reduce (bucketed)", comm_bucketed_s,
+        cost.ring_all_reduce_s(opt_ar_bytes, N, n_ops=1),
+    )
 
     # ---- 3b. two-level alpha-beta: the hierarchical bucketed reducer -
     # 64 chips as DCN_SLICES slices × ici chips. A FLAT 64-ring would
@@ -271,6 +286,18 @@ def main():
           f"{eff_flat_dcn:.3f} (flat ring over DCN) -> "
           f"{eff_two_level:.3f} (hierarchical bucketed, no overlap) .. "
           f"{eff_two_level_overlap:.3f} (full overlap)")
+    _assert_cost_engine_agrees(
+        "flat ring over dcn", comm_flat_dcn_s,
+        cost.ring_all_reduce_s(
+            opt_ar_bytes, N, n_ops=1, bw=BW_DCN_EFFECTIVE
+        ),
+    )
+    _assert_cost_engine_agrees(
+        "two-level bucketed reducer", comm_two_level_s,
+        cost.two_level_all_reduce_s(
+            opt_ar_bytes, ici, DCN_SLICES, n_buckets=n_buckets
+        ),
+    )
 
     # ---- 3b'. compressed 'dcn' wire on the bucketed reducer ----------
     # (`ops/wire_codec.py`, PR 11). The intra-slice legs stay f32; only
@@ -307,6 +334,13 @@ def main():
               f"ms, total comm {comm_wire_s*1e3:.2f} ms, "
               f"efficiency {eff_wire:.3f} (f32 hierarchical: "
               f"{eff_two_level:.3f})")
+        _assert_cost_engine_agrees(
+            f"compressed grad wire ({wire})", comm_wire_s,
+            cost.two_level_all_reduce_s(
+                opt_ar_bytes, ici, DCN_SLICES, n_buckets=n_buckets,
+                wire=wire,
+            ),
+        )
 
     # ---- 3c. two-level a2a: the hierarchical MoE token exchange ------
     # One routed layer's dispatch+combine at 64 chips as DCN_SLICES x
@@ -351,6 +385,16 @@ def main():
           f"flat {a2a_flat_s*1e3:.2f} ms/exchange "
           f"({(DCN_SLICES-1)*ici} DCN hops) -> two-level "
           f"{a2a_two_level_s*1e3:.2f} ms ({DCN_SLICES-1} DCN hop)")
+    _assert_cost_engine_agrees(
+        "MoE flat a2a", a2a_flat_s,
+        cost.flat_all_to_all_s(moe_x_elems, 2, ici, DCN_SLICES),
+    )
+    _assert_cost_engine_agrees(
+        "MoE two-level a2a", a2a_two_level_s,
+        cost.hierarchical_all_to_all_s(
+            moe_x_elems, 2, ici, DCN_SLICES
+        ),
+    )
     print(f"per MoE layer (2 exchanges + FFN {moe_ffn_s*1e3:.2f} ms): "
           f"flat {moe_layer_flat_s*1e3:.2f} ms, hierarchical "
           f"{moe_layer_two_level_s*1e3:.2f} ms, overlapped "
@@ -385,6 +429,12 @@ def main():
               f"{a2a_wire_s*1e3:.2f} ms/exchange, per layer "
               f"{layer_s*1e3:.2f} ms unfused / "
               f"{layer_overlap_s*1e3:.2f} ms overlapped")
+        _assert_cost_engine_agrees(
+            f"compressed dispatch wire ({wire})", a2a_wire_s,
+            cost.hierarchical_all_to_all_s(
+                moe_x_elems, 2, ici, DCN_SLICES, wire=wire
+            ),
+        )
 
     out = {
         "n_devices": N,

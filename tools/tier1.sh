@@ -78,6 +78,28 @@ echo "[tier1] hlolint pre-gate ok:" \
   "$(grep -ac '"partial": true' /tmp/_t1_hlolint.log || true)" \
   "combo(s) lint clean"
 
+# costgate pre-gate (the perf twin of the hlolint pre-gate): the
+# static cost engine re-prices the tier-1 combo cut against the
+# committed ledger (experiments/cost_ledger.json) and name-checks
+# every full-matrix combo for ledger coverage — a combo whose
+# predicted step time regressed past tolerance, or a new combo shipped
+# without a cost baseline, fails in seconds with the combo NAMED.
+# Exit 4 distinguishes a cost regression from a contract violation (3)
+# and a collection failure (2).
+rm -f /tmp/_t1_costgate.log
+if ! timeout -k 5 300 bash tools/costgate --pregate \
+    > /tmp/_t1_costgate.log 2>&1; then
+  echo "[tier1] COSTGATE PRE-GATE FAILED — a combo's predicted step" \
+    "time regressed or lacks a ledger row (tools/costgate," \
+    "INTERNALS.md section 13):"
+  grep -aE "FAIL|costgate" /tmp/_t1_costgate.log | head -20
+  echo DOTS_PASSED=0
+  exit 4
+fi
+echo "[tier1] costgate pre-gate ok:" \
+  "$(grep -ac '"partial": true' /tmp/_t1_costgate.log || true)" \
+  "combo(s) priced within tolerance"
+
 rm -f /tmp/_t1.log
 timeout -k 10 870 env JAX_PLATFORMS=cpu \
     python -m pytest tests/ -q -m 'not slow' \
