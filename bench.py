@@ -174,15 +174,25 @@ def _ledger_predicted_ms(combo_name: str):
     return round(float(row["predicted_step_s"]) * 1e3, 6)
 
 
-def _with_predicted(row: dict, *combo_names: str) -> dict:
+def _with_predicted(row: dict, *combo_names: str,
+                    measured_key: str = None) -> dict:
     """Attach the first ledger hit among `combo_names` (the matrix
     ships some shapes only in a model/overlap variant, so callers pass
-    the exact twin first and its variants as fallbacks)."""
+    the exact twin first and its variants as fallbacks). When
+    `measured_key` names the row's measured-ms column, also attach
+    `delta_pct` (measured vs predicted, +slower) so prediction drift
+    is visible in every committed BENCH artifact and per-leg partial
+    line — the drift `tools/obsreport`/`calibrate.py` reconcile."""
     for name in combo_names:
         ms = _ledger_predicted_ms(name)
         if ms is not None:
             row["predicted_ms"] = ms
             row["predicted_combo"] = name
+            measured = row.get(measured_key) if measured_key else None
+            if measured is not None and ms > 0:
+                row["delta_pct"] = round(
+                    (float(measured) - ms) / ms * 100.0, 1
+                )
             return row
     return row
 
@@ -759,6 +769,11 @@ def run_child_cm(max_devices: int, platform: str = "cpu") -> None:
         if ag is not None and rs is not None:
             row["predicted_ms"] = round(ag + rs, 6)
             row["predicted_combo"] = f"cm_ag+cm_rs/S{size}"
+            if row["predicted_ms"] > 0:
+                row["delta_pct"] = round(
+                    (row["fwd_overlapped_ms"] - row["predicted_ms"])
+                    / row["predicted_ms"] * 100.0, 1
+                )
         rows.append(row)
         log(f"S={size}: fwd {row['fwd_naive_ms']}ms naive vs "
             f"{row['fwd_overlapped_ms']}ms overlapped")
@@ -995,7 +1010,8 @@ def run_child_reducer(max_devices: int, platform: str = "cpu") -> None:
         )
         # Ledger column keyed on the hierarchical leg's lint-matrix
         # twin (the 2 x S/2 dcn x ici bucketed reducer).
-        _with_predicted(row, f"ddp/S{size}/dcn2/bucketed")
+        _with_predicted(row, f"ddp/S{size}/dcn2/bucketed",
+                        measured_key="hierarchical_ms")
         rows.append(row)
         log(f"S={size}: naive {row['naive_ms']}ms, bucketed "
             f"{row['bucketed_ms']}ms, hierarchical "
@@ -1031,6 +1047,7 @@ def run_child_reducer(max_devices: int, platform: str = "cpu") -> None:
                 f"ddp/S{size}/dcn2/bucketed/wire-{wire}",
                 f"ddp/S{size}/dcn2/bucketed/wire-{wire}/tinycnn",
                 f"ddp/S{size}/dcn2/overlapped/wire-{wire}",
+                measured_key="hierarchical_ms",
             )
             rows.append(wrow)
             log(f"S={size} wire={wire}: hierarchical "
@@ -1205,6 +1222,7 @@ def run_child_moe(max_devices: int, platform: str = "cpu") -> None:
             row,
             f"ep/S{size}/dcn2/hierarchical",
             f"ep/S{size}/dcn2/hierarchical/ov",
+            measured_key="hierarchical_ms",
         )
         rows.append(row)
         log(f"S={size}: flat {row['flat_ms']}ms, hierarchical "
@@ -1242,6 +1260,7 @@ def run_child_moe(max_devices: int, platform: str = "cpu") -> None:
                 wrow,
                 f"ep/S{size}/dcn2/hierarchical/wire-{wire}",
                 f"ep/S{size}/dcn2/hierarchical/ov/wire-{wire}",
+                measured_key="hierarchical_ms",
             )
             rows.append(wrow)
             log(f"S={size} wire={wire}: hierarchical "
@@ -1306,6 +1325,9 @@ def run_child_serving(max_devices: int, platform: str = "cpu") -> None:
     import numpy as np
 
     from distributed_model_parallel_tpu.models.gpt import GPTConfig
+    from distributed_model_parallel_tpu.observability.metrics import (
+        exact_quantile,
+    )
     from distributed_model_parallel_tpu.runtime.mesh import (
         MeshSpec,
         make_mesh,
@@ -1376,17 +1398,21 @@ def run_child_serving(max_devices: int, platform: str = "cpu") -> None:
             jax.block_until_ready(logits)
             decode_ms.append((time.perf_counter() - t0) * 1e3)
 
+        # p50/p99 via the repo's ONE percentile rule
+        # (observability/metrics.exact_quantile — the same math the
+        # serving scheduler's latency report uses; pinned equal to the
+        # retired numpy.percentile columns on canned latencies).
         pf, dc = np.asarray(prefill_ms), np.asarray(decode_ms)
         row = {
             "layout": layout + ("_cm" if cm else ""),
             "axis_size": size,
-            "prefill_p50_ms": round(float(np.percentile(pf, 50)), 3),
-            "prefill_p99_ms": round(float(np.percentile(pf, 99)), 3),
+            "prefill_p50_ms": round(exact_quantile(prefill_ms, 50), 3),
+            "prefill_p99_ms": round(exact_quantile(prefill_ms, 99), 3),
             "prefill_tokens_per_s": round(
                 p_len * len(pf) / (pf.sum() / 1e3), 1
             ),
-            "decode_p50_ms": round(float(np.percentile(dc, 50)), 3),
-            "decode_p99_ms": round(float(np.percentile(dc, 99)), 3),
+            "decode_p50_ms": round(exact_quantile(decode_ms, 50), 3),
+            "decode_p99_ms": round(exact_quantile(decode_ms, 99), 3),
             "decode_tokens_per_s": round(
                 num_slots * len(dc) / (dc.sum() / 1e3), 1
             ),
@@ -1395,7 +1421,8 @@ def run_child_serving(max_devices: int, platform: str = "cpu") -> None:
             # The lint matrix's serving combos are the tp decode step
             # (declarative and opted-in rings).
             _with_predicted(
-                row, f"serve/S{size}" + ("/cm" if cm else "")
+                row, f"serve/S{size}" + ("/cm" if cm else ""),
+                measured_key="decode_p50_ms",
             )
         rows.append(row)
         log(f"{row['layout']} S={size}: prefill p50 "

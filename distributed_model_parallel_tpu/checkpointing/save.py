@@ -107,6 +107,9 @@ def save_sharded(
     # `ckpt_snapshot` is the span the step path pays even under a
     # writer (observability/trace.py; the I/O half records
     # `ckpt_background_write` on the writer thread).
+    from distributed_model_parallel_tpu.observability.metrics import (
+        get_metrics,
+    )
     from distributed_model_parallel_tpu.observability.trace import (
         get_tracer,
     )
@@ -115,8 +118,11 @@ def save_sharded(
     proc_to_file: dict[int, int] = {}
     records: dict[str, LeafRecord] = {}
     my_arrays: dict[str, Any] = {}
-    with get_tracer().span("ckpt_snapshot", snapshot=name,
-                           save_id=save_id):
+    tracer = get_tracer()
+    mx = get_metrics()
+    t0 = tracer.now() if mx.enabled else None
+    with tracer.span("ckpt_snapshot", snapshot=name,
+                     save_id=save_id):
         for path, leaf in leaves_with_paths:
             key = _path_str(path)
             chunks = []
@@ -142,6 +148,8 @@ def save_sharded(
                 spec=leaf_spec_json(leaf),
                 chunks=chunks,
             )
+    if t0 is not None:
+        mx.observe("ckpt_snapshot_s", tracer.now() - t0)
     shard_files = [
         shard_file_name(name, save_id, p) for p in writing_processes
     ]

@@ -95,8 +95,12 @@ class AsyncCheckpointer:
             self._thread.start()
 
     def _run(self) -> None:
-        # Local import keeps this module's surface numpy-only for the
-        # monkeypatching tests; the tracer itself is stdlib-only.
+        # Local imports keep this module's surface numpy-only for the
+        # monkeypatching tests; tracer and registry are stdlib-only
+        # and thread-safe by contract.
+        from distributed_model_parallel_tpu.observability.metrics import (
+            get_metrics,
+        )
         from distributed_model_parallel_tpu.observability.trace import (
             get_tracer,
         )
@@ -106,14 +110,21 @@ class AsyncCheckpointer:
             if item is None:
                 return
             job, handle = item
+            tracer = get_tracer()
+            mx = get_metrics()
+            t0 = tracer.now() if mx.enabled else None
             try:
                 # The I/O half of a save, on THIS thread — the span the
                 # Chrome trace shows running beside the main loop's
                 # steps (the step path only paid ckpt_snapshot).
-                with get_tracer().span(
+                with tracer.span(
                     "ckpt_background_write", path=handle.path
                 ):
                     job()
+                if t0 is not None:
+                    mx.observe(
+                        "ckpt_background_write_s", tracer.now() - t0
+                    )
                 handle._finish(None)
             except BaseException as e:  # noqa: BLE001 — stored, re-raised
                 # Store the checkpointer-level error BEFORE publishing

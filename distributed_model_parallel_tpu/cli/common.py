@@ -623,3 +623,45 @@ def add_common_tpu_flags(parser: argparse.ArgumentParser) -> None:
         "--log-file", default=None,
         help="epoch log filename under ./log (reference: 512.txt)",
     )
+    add_metrics_out_flag(parser)
+
+
+def add_metrics_out_flag(parser: argparse.ArgumentParser) -> None:
+    parser.add_argument(
+        "--metrics-out", default=None, metavar="PATH",
+        help="enable the metrics registry (observability/metrics.py) "
+             "and write its export here at exit: Prometheus text when "
+             "PATH ends in .prom, JSON otherwise (what tools/obsreport "
+             "--metrics ingests). Fails fast if PATH's directory does "
+             "not exist.",
+    )
+
+
+def setup_metrics_out(path) -> None:
+    """Validate + enable for `--metrics-out` (call BEFORE anything
+    compiles: a mistyped directory must not surface as a lost export
+    after the whole run — same contract as serve's --trace-out)."""
+    if not path:
+        return
+    import os
+
+    out_dir = os.path.dirname(os.path.abspath(path))
+    if not os.path.isdir(out_dir):
+        raise SystemExit(
+            f"--metrics-out {path}: directory {out_dir} does not exist"
+        )
+    from distributed_model_parallel_tpu.observability import metrics
+
+    metrics.enable()
+
+
+def export_metrics_out(path) -> None:
+    """Write the registry export at run end (host 0 only)."""
+    if not path or jax.process_index() != 0:
+        return
+    from distributed_model_parallel_tpu.observability.metrics import (
+        get_metrics,
+    )
+
+    get_metrics().export(path)
+    print(f"==> wrote metrics to {path}", flush=True)

@@ -138,6 +138,11 @@ def main(argv=None) -> dict:
             raise SystemExit(f"--finetune: no such file {args.finetune!r}")
     check_grad_reduction_args(args)
     check_checkpoint_args(args)
+    from distributed_model_parallel_tpu.cli.common import (
+        setup_metrics_out,
+    )
+
+    setup_metrics_out(args.metrics_out)  # fail fast on a bad directory
     if args.grad_reduction != "monolithic" and args.engine not in (
         "ddp", "fsdp"
     ):
@@ -353,11 +358,18 @@ def main(argv=None) -> dict:
             elastic_fit,
         )
 
-        return elastic_fit(
+        out = elastic_fit(
             make_trainer, max_restarts=args.max_restarts,
             checkpoint_dir=checkpoint_dir,
         )
-    return make_trainer(False).fit()
+    else:
+        out = make_trainer(False).fit()
+    from distributed_model_parallel_tpu.cli.common import (
+        export_metrics_out,
+    )
+
+    export_metrics_out(args.metrics_out)
+    return out
 
 
 if __name__ == "__main__":

@@ -153,11 +153,21 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--log-file", default=None)
     p.add_argument("--profile-dir", default=None)
     p.add_argument("--resume", "-r", action="store_true")
+    from distributed_model_parallel_tpu.cli.common import (
+        add_metrics_out_flag,
+    )
+
+    add_metrics_out_flag(p)
     return p
 
 
 def main(argv=None) -> dict:
     args = build_parser().parse_args(argv)
+    from distributed_model_parallel_tpu.cli.common import (
+        setup_metrics_out,
+    )
+
+    setup_metrics_out(args.metrics_out)  # fail fast on a bad directory
     initialize_backend()
     if args.pipeline_stages > 1 and args.seq_shards > 1:
         raise SystemExit(
@@ -451,6 +461,11 @@ def main(argv=None) -> dict:
     trainer = Trainer(engine, train, val, tcfg, rng=jax.random.PRNGKey(0))
     out = trainer.fit()
     out["loss_floor"] = floor
+    from distributed_model_parallel_tpu.cli.common import (
+        export_metrics_out,
+    )
+
+    export_metrics_out(args.metrics_out)
     return out
 
 

@@ -150,6 +150,11 @@ def main(argv=None) -> dict:
         args.pipeline_schedule, args.virtual_stages, args.microbatches,
         args.world_size,
     )
+    from distributed_model_parallel_tpu.cli.common import (
+        setup_metrics_out,
+    )
+
+    setup_metrics_out(args.metrics_out)
     initialize_backend(coordinator_address=args.dist_url)
     mesh = make_mesh(MeshSpec(data=-1, stage=args.world_size))
     check_batch_divisibility(
@@ -185,7 +190,13 @@ def main(argv=None) -> dict:
         profile_dir=args.profile_dir,
     )
     trainer = Trainer(engine, train, val, cfg, rng=jax.random.PRNGKey(0))
-    return trainer.fit()
+    out = trainer.fit()
+    from distributed_model_parallel_tpu.cli.common import (
+        export_metrics_out,
+    )
+
+    export_metrics_out(args.metrics_out)
+    return out
 
 
 if __name__ == "__main__":

@@ -93,6 +93,11 @@ def build_parser() -> argparse.ArgumentParser:
                         "observability/trace.py; open in "
                         "chrome://tracing or Perfetto). Fails fast if "
                         "PATH's directory does not exist.")
+    from distributed_model_parallel_tpu.cli.common import (
+        add_metrics_out_flag,
+    )
+
+    add_metrics_out_flag(p)
     # Synthetic trace.
     p.add_argument("--num-requests", default=16, type=int)
     p.add_argument("--prompt-len-min", default=4, type=int)
@@ -183,6 +188,11 @@ def _checkpoint_guard(directory: str, name: str, cfg) -> None:
 def main(argv=None) -> dict:
     args = build_parser().parse_args(argv)
     check_serving_args(args)
+    from distributed_model_parallel_tpu.cli.common import (
+        setup_metrics_out,
+    )
+
+    setup_metrics_out(args.metrics_out)  # fail fast on a bad directory
     if args.trace_out:
         # Fail BEFORE any engine compiles: a mistyped directory must
         # not surface as a lost trace after the whole run.
@@ -291,6 +301,12 @@ def main(argv=None) -> dict:
         trace.enable()
     sched = engine.run(params, requests)
     report = sched.latency_report()
+    if args.metrics_out:
+        from distributed_model_parallel_tpu.cli.common import (
+            export_metrics_out,
+        )
+
+        export_metrics_out(args.metrics_out)
     if args.trace_out and jax.process_index() == 0:
         from distributed_model_parallel_tpu.observability import trace
 

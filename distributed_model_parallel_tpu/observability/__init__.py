@@ -1,9 +1,20 @@
 """Observability: the span tracer (`trace.py` — host-side runtime
-timeline, Chrome trace export) and the static cost engine (`cost.py` —
-shared alpha-beta constants, closed-form composition formulas, and the
-per-combo predictor `tools/costgate` gates against
-`experiments/cost_ledger.json`). INTERNALS.md §13."""
+timeline, Chrome trace export), the metrics registry (`metrics.py` —
+counters/gauges/histograms with streaming quantiles, Prometheus +
+JSON export, the ONE percentile rule), the static cost engine
+(`cost.py` — shared alpha-beta constants, closed-form composition
+formulas, and the per-combo predictor `tools/costgate` gates against
+`experiments/cost_ledger.json`), and the measured half that closes
+the loop: trace attribution (`attribution.py`), constant calibration
+from measured rows (`calibrate.py`), and the unified run report
+(`report.py`, `tools/obsreport`). INTERNALS.md §13–§14."""
 
+from distributed_model_parallel_tpu.observability.metrics import (  # noqa: F401,E501
+    MetricsRegistry,
+    exact_quantile,
+    get_metrics,
+    set_metrics,
+)
 from distributed_model_parallel_tpu.observability.trace import (  # noqa: F401
     Tracer,
     disable,
@@ -13,9 +24,13 @@ from distributed_model_parallel_tpu.observability.trace import (  # noqa: F401
 )
 
 __all__ = [
+    "MetricsRegistry",
     "Tracer",
     "disable",
     "enable",
+    "exact_quantile",
+    "get_metrics",
     "get_tracer",
+    "set_metrics",
     "set_tracer",
 ]

@@ -92,6 +92,42 @@ ICI = Fabric("ici", ALPHA_HOP_S, BW_ICI_EFFECTIVE)
 DCN = Fabric("dcn", ALPHA_DCN_HOP_S, BW_DCN_EFFECTIVE)
 
 
+def load_calibration(path: str) -> Dict[str, float]:
+    """Constants out of a `calibrate.py` artifact — the MEASURED
+    stand-in for the hand block above. Validates the schema and that
+    every hand constant has a fitted twin, so a caller swapping
+    physics can never silently run on a partial set."""
+    import json
+
+    with open(path) as f:
+        data = json.load(f)
+    constants = data.get("constants")
+    if not isinstance(constants, dict):
+        raise ValueError(f"{path}: not a calibration file "
+                         "(no 'constants' object)")
+    missing = sorted(set(CONSTANTS) - set(constants))
+    if missing:
+        raise ValueError(
+            f"{path}: calibration is missing constants "
+            f"{', '.join(missing)} — refit with calibrate.py"
+        )
+    return {k: float(constants[k]) for k in CONSTANTS}
+
+
+def fabrics_from_constants(
+    constants: Dict[str, float],
+) -> "tuple[Fabric, Fabric]":
+    """(ICI, DCN) fabrics under explicit constants (e.g. a loaded
+    calibration) — what a measured-ledger regeneration would price
+    with."""
+    return (
+        Fabric("ici", constants["alpha_hop_s"],
+               constants["bw_ici_effective_bytes_per_s"]),
+        Fabric("dcn", constants["alpha_dcn_hop_s"],
+               constants["bw_dcn_effective_bytes_per_s"]),
+    )
+
+
 # ------------------------------------------- closed-form compositions
 #
 # The scaling64 §3 formulas as functions. Arguments are payload bytes
@@ -305,8 +341,10 @@ __all__ = [
     "ICI",
     "WIRE_ITEMSIZE",
     "combo_cost",
+    "fabrics_from_constants",
     "flat_all_to_all_s",
     "hierarchical_all_to_all_s",
+    "load_calibration",
     "predict_collectives",
     "ring_all_reduce_s",
     "two_level_all_reduce_s",

@@ -197,7 +197,38 @@ def main(argv: Optional[List[str]] = None) -> int:
              f"{DEFAULT_TOLERANCE})",
     )
     parser.add_argument("--devices", type=int, default=8)
+    parser.add_argument(
+        "--calibration", default=None, metavar="JSON",
+        help="report (never gate on) the drift between the fitted "
+             "constants in this calibration.json "
+             "(observability/calibrate.py) and the committed hand "
+             "constants — measured physics informs the model; the "
+             "gate stays a structural check on the lowered program",
+    )
     args = parser.parse_args(argv)
+
+    calibration_drift = None
+    if args.calibration:
+        from distributed_model_parallel_tpu.observability.calibrate import (  # noqa: E501
+            drift_report,
+        )
+        from distributed_model_parallel_tpu.observability.cost import (
+            load_calibration,
+        )
+
+        try:
+            fitted = load_calibration(args.calibration)
+        except (OSError, ValueError) as e:
+            print(f"[costgate] cannot read calibration: {e}",
+                  file=sys.stderr)
+            return 2
+        calibration_drift = drift_report(fitted)
+        for key, pct in calibration_drift.items():
+            print(
+                f"[costgate] calibration drift (reported, not "
+                f"gated): {key} committed {CONSTANTS[key]:g} -> "
+                f"fitted {fitted[key]:g} ({pct:+.1f}%)"
+            )
 
     # Virtual CPU devices BEFORE any backend initializes (same guard as
     # tools/hlolint: this environment preloads a TPU PJRT plugin).
@@ -295,17 +326,18 @@ def main(argv: Optional[List[str]] = None) -> int:
     ]
     for f in failures:
         print(f"[costgate] FAIL {f}")
-    print(json.dumps({
-        "costgate": {
-            "ledger": args.ledger,
-            "gated": len(rows),
-            "name_checked": len(matrix) if args.pregate else len(rows),
-            "failures": len(failures),
-            "failed_targets": sorted(
-                {f.split(":", 1)[0] for f in failures}
-            ),
-        }
-    }))
+    summary = {
+        "ledger": args.ledger,
+        "gated": len(rows),
+        "name_checked": len(matrix) if args.pregate else len(rows),
+        "failures": len(failures),
+        "failed_targets": sorted(
+            {f.split(":", 1)[0] for f in failures}
+        ),
+    }
+    if calibration_drift is not None:
+        summary["calibration_drift_pct"] = calibration_drift
+    print(json.dumps({"costgate": summary}))
     return EXIT_GATE_FAILED if failures else 0
 
 

@@ -52,6 +52,9 @@ from distributed_model_parallel_tpu.models.gpt import (
     gpt_lm,
     head_apply,
 )
+from distributed_model_parallel_tpu.observability.metrics import (
+    get_metrics,
+)
 from distributed_model_parallel_tpu.observability.trace import get_tracer
 from distributed_model_parallel_tpu.ops.attention import (
     dot_product_attention,
@@ -448,6 +451,7 @@ class ServingEngine:
         completion (greedy decoding), returning the Scheduler with its
         per-request `finished` records and `latency_report()`."""
         tracer = get_tracer()
+        mx = get_metrics()  # per-call histograms; one branch when off
         sched = Scheduler(self.num_slots, self.max_len)
         for r in requests:
             if r.prompt.size > self.prefill_len:
@@ -464,6 +468,7 @@ class ServingEngine:
             while sched.can_admit():
                 seq = sched.admit()
                 ids, length = self.pad_prompt(seq.request.prompt)
+                t0 = tracer.now()
                 with tracer.span("prefill", rid=repr(seq.request.rid),
                                  slot=seq.slot):
                     cache, next_logits = self.prefill(
@@ -471,6 +476,15 @@ class ServingEngine:
                     )
                     tok = int(np.asarray(next_logits).argmax())
                 seq.t_first_token = tracer.now()
+                if mx.enabled:
+                    mx.observe(
+                        "serve_prefill_s", seq.t_first_token - t0
+                    )
+                    # The prefill produced this request's FIRST token;
+                    # decode steps count theirs in record_decode_step,
+                    # so the counter totals to the report's
+                    # generated_tokens exactly.
+                    mx.inc("serve_tokens_total", 1)
                 seq.generated.append(tok)
                 tokens[seq.slot] = tok
                 active[seq.slot] = True
@@ -491,6 +505,8 @@ class ServingEngine:
             dt = tracer.now() - t0
             sched.record_decode_step(n_active)
             tracer.counter("batch_occupancy", n_active)
+            if mx.enabled:
+                mx.observe("serve_decode_step_s", dt)
             for slot, seq in list(sched.active.items()):
                 tok = int(logits_np[slot].argmax())
                 seq.generated.append(tok)

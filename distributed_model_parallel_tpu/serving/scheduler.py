@@ -21,6 +21,10 @@ from typing import Any, Deque, Dict, List, Optional
 
 import numpy as np
 
+from distributed_model_parallel_tpu.observability.metrics import (
+    exact_quantile,
+    get_metrics,
+)
 from distributed_model_parallel_tpu.observability.trace import get_tracer
 from distributed_model_parallel_tpu.serving.kv_cache import SlotAllocator
 
@@ -164,6 +168,16 @@ class Scheduler:
                 "decode", seq.t_first_token, now, tid=tid,
                 tokens=len(fin.tokens), slot=slot,
             )
+        # Request-lifecycle histograms (observability/metrics.py; one
+        # branch when disabled): queued / TTFT legs and every token's
+        # decode latency — the distributions the latency report's
+        # quantiles summarize, live on the exposition surface.
+        mx = get_metrics()
+        if mx.enabled:
+            mx.observe("serve_queued_s", seq.t_admit - seq.t_submit)
+            mx.observe("serve_ttft_s", fin.prefill_s)
+            for t in fin.decode_s:
+                mx.observe("serve_token_s", t)
         return fin
 
     def record_decode_step(self, n_active: int) -> None:
@@ -172,6 +186,10 @@ class Scheduler:
         latency legs already live on each Sequence, so occupancy is the
         only new information)."""
         self.step_occupancy.append(int(n_active))
+        mx = get_metrics()
+        if mx.enabled:
+            mx.gauge("serve_batch_occupancy", int(n_active))
+            mx.inc("serve_tokens_total", int(n_active))
 
     def has_work(self) -> bool:
         return bool(self.waiting) or bool(self.active)
@@ -188,13 +206,20 @@ class Scheduler:
         IS tokens-out over token capacity — the continuous-batching
         claim as a number)."""
         fins = self.finished
-        decode = np.asarray(
-            [t for f in fins for t in f.decode_s], np.float64
-        )
-        prefill = np.asarray([f.prefill_s for f in fins], np.float64)
+        decode = [t for f in fins for t in f.decode_s]
+        prefill = [f.prefill_s for f in fins]
         n_tokens = int(sum(len(f.tokens) for f in fins))
         total = max((f.total_s for f in fins), default=0.0)
         occ = np.asarray(self.step_occupancy, np.float64)
+        goodput = (
+            round(
+                float(occ.sum()) / (occ.size * self.slots.num_slots), 4
+            )
+            if occ.size else None
+        )
+        mx = get_metrics()
+        if mx.enabled and goodput is not None:
+            mx.gauge("serve_goodput", goodput)
         out = {
             "requests": len(fins),
             "generated_tokens": n_tokens,
@@ -209,22 +234,18 @@ class Scheduler:
             "mean_batch_occupancy": (
                 round(float(occ.mean()), 3) if occ.size else None
             ),
-            "goodput": (
-                round(
-                    float(occ.sum())
-                    / (occ.size * self.slots.num_slots),
-                    4,
-                )
-                if occ.size else None
-            ),
+            "goodput": goodput,
         }
         return out
 
 
-def _pct(xs: np.ndarray, q: float):
-    if xs.size == 0:
-        return None
-    return round(float(np.percentile(xs, q)) * 1e3, 3)
+def _pct(xs, q: float):
+    """Milliseconds quantile of a seconds sample list through the
+    repo's ONE percentile rule (`observability/metrics.exact_quantile`
+    — regression-pinned equal to the retired `numpy.percentile` math
+    on canned latencies); None when empty."""
+    v = exact_quantile(xs, q)
+    return None if v is None else round(v * 1e3, 3)
 
 
 __all__ = [

@@ -40,6 +40,9 @@ from distributed_model_parallel_tpu.checkpointing import (
     restore_checkpoint,
     save_sharded,
 )
+from distributed_model_parallel_tpu.observability.metrics import (
+    get_metrics,
+)
 from distributed_model_parallel_tpu.observability.trace import get_tracer
 from distributed_model_parallel_tpu.runtime.dist import is_primary
 from distributed_model_parallel_tpu.training.checkpoint import (
@@ -214,8 +217,13 @@ class Trainer:
         # step = the dispatch call (enqueue under async dispatch),
         # sync = the value-fetch fences where device time surfaces,
         # checkpoint_blocked = how long a save holds this loop
-        # (_write_checkpoint).
+        # (_write_checkpoint). The metrics registry
+        # (observability/metrics.py; same off-by-default discipline)
+        # mirrors the phases as distributions: train_fetch_s /
+        # train_step_s histograms, timestamps from the tracer's
+        # injectable clock so tests stay deterministic.
         tracer = get_tracer()
+        mx = get_metrics()
         lr = jnp.asarray(self.lr_fn(epoch), jnp.float32)
         if hasattr(self.train_loader, "set_epoch"):
             # Re-seed the per-epoch shuffle + augmentation RNG (the torch
@@ -284,13 +292,27 @@ class Trainer:
                     return []
             with tracer.span("fetch", want=want):
                 t0 = time.perf_counter()
+                tm0 = tracer.now() if mx.enabled else 0.0
                 host_batches = group_batches(it, want)
                 data_time += time.perf_counter() - t0
+                if mx.enabled and host_batches:
+                    # Metric clock = tracer clock (injectable), like
+                    # train_step_s; data_time keeps the wall clock the
+                    # reference's report fields are defined on.
+                    mx.observe(
+                        "train_fetch_s",
+                        (tracer.now() - tm0) / len(host_batches),
+                    )
                 return [
                     self.engine.shard_batch(*b) for b in host_batches
                 ]
 
         epoch_start = time.perf_counter()
+        # Metrics state: the step-time boundary clock (tracer domain,
+        # so tests inject it) and the one-deep progress-print snapshot
+        # (n_batches, metrics) of the PREVIOUS dispatch group.
+        t_boundary = tracer.now() if mx.enabled else None
+        printable = None
         placed = fetch_group(0)
         while placed:
             if (
@@ -329,7 +351,8 @@ class Trainer:
                             )
                         )
             prev = n_batches
-            n_batches += len(placed)
+            n_group = len(placed)
+            n_batches += n_group
             # One-deep device prefetch: the dispatch above returned at
             # enqueue time, so the next group's host load + placement
             # overlaps the in-flight compute — and, crucially, runs
@@ -350,18 +373,45 @@ class Trainer:
                 if sums is None
                 else jax.tree_util.tree_map(jnp.add, sums, metrics)
             )
+            if mx.enabled:
+                # Step-time sample at dispatch granularity (boundary
+                # to boundary, prefetch included), CLOSED before the
+                # progress-print fetch below so the histogram can
+                # never measure its own readback stall.
+                t_now = tracer.now()
+                if t_boundary is not None:  # None: enabled mid-epoch
+                    mx.observe(
+                        "train_step_s", (t_now - t_boundary) / n_group
+                    )
+                mx.inc("train_batches_total", n_group)
+                t_boundary = t_now
             if cfg.print_freq and (
                 n_batches // cfg.print_freq > prev // cfg.print_freq
             ):
+                # Fetch the PREVIOUS group's metrics (the one-deep
+                # snapshot seam, same shape as the input prefetch): a
+                # newer dispatch already runs behind them, so this
+                # device_get returns without fencing the in-flight
+                # compute — the progress print no longer injects a
+                # readback stall into the loop it reports on
+                # (RESULTS §2's fence note; regression-pinned with an
+                # injected slow clock in tests/test_observability.py).
+                # The first print of an epoch has no predecessor and
+                # falls back to fencing the current group.
+                snap_n, snap_metrics = (
+                    printable if printable is not None
+                    else (n_batches, metrics)
+                )
                 with tracer.span("sync"):
-                    m = jax.device_get(metrics)  # fences this dispatch
+                    m = jax.device_get(snap_metrics)
                 self._log_print(
                     f"Epoch: [{epoch}]"
-                    f"[{n_batches}/{n_avail if n_avail is not None else '?'}]"
+                    f"[{snap_n}/{n_avail if n_avail is not None else '?'}]"
                     f"\tLoss {m['loss_sum'] / m['count']:.4e}"
                     f"\tAcc@1 {100.0 * m['correct1'] / m['count']:.3f}"
                     f"\tTime {(time.perf_counter() - epoch_start) / n_batches:.3f}"
                 )
+            printable = (n_batches, metrics)
         # Value-fetch barrier: on a tunneled/remote backend
         # block_until_ready can return at dispatch time (see
         # bench._sync), but fetching the summed metrics' bytes cannot
@@ -506,26 +556,36 @@ class Trainer:
         # loop: the whole write for sync formats, only the device->host
         # snapshot under async_save (the writer thread records its own
         # ckpt_background_write span — checkpointing/writer.py).
-        with get_tracer().span(
-            "checkpoint_blocked", snapshot=name, epoch=epoch,
-            format=cfg.checkpoint_format,
-        ):
-            if cfg.checkpoint_format == "legacy":
-                save_checkpoint(
+        tracer = get_tracer()
+        mx = get_metrics()
+        t0 = tracer.now() if mx.enabled else None
+        try:
+            with tracer.span(
+                "checkpoint_blocked", snapshot=name, epoch=epoch,
+                format=cfg.checkpoint_format,
+            ):
+                if cfg.checkpoint_format == "legacy":
+                    save_checkpoint(
+                        cfg.checkpoint_dir, payload, acc=self.best_acc,
+                        epoch=epoch, name=name,
+                        extra=cfg.checkpoint_extra,
+                    )
+                    return
+                if self._ckpt_writer is not None:
+                    # Surface an earlier epoch's failed background
+                    # write BEFORE starting a new one
+                    # (checkpointing/writer.py contract).
+                    self._ckpt_writer.check()
+                save_sharded(
                     cfg.checkpoint_dir, payload, acc=self.best_acc,
                     epoch=epoch, name=name, extra=cfg.checkpoint_extra,
+                    writer=self._ckpt_writer,
                 )
-                return
-            if self._ckpt_writer is not None:
-                # Surface an earlier epoch's failed background write
-                # BEFORE starting a new one (checkpointing/writer.py
-                # contract).
-                self._ckpt_writer.check()
-            save_sharded(
-                cfg.checkpoint_dir, payload, acc=self.best_acc,
-                epoch=epoch, name=name, extra=cfg.checkpoint_extra,
-                writer=self._ckpt_writer,
-            )
+        finally:
+            if t0 is not None:
+                mx.observe(
+                    "train_checkpoint_blocked_s", tracer.now() - t0
+                )
 
     def _to_canonical(self, state):
         """Checkpoints are written in the engine's layout-independent

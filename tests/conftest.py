@@ -63,6 +63,31 @@ def pytest_collection_modifyitems(config, items):
             "(tier-1 budget): " + ", ".join(sorted(offenders))
         )
 
+    # Observability-name META-CHECK: every span/counter/metric name
+    # emitted anywhere in the package (literal first argument to
+    # .span/.counter/.instant/.complete/.observe/.inc/.gauge) must
+    # appear in the documented registries
+    # (observability/metrics.py: TRACE_EVENT_NAMES / METRIC_NAMES) —
+    # an undocumented series is invisible to obsreport and to the
+    # exposition surface's consumers. Pure source scan, no items
+    # needed, so it runs on every collection; import is jax-free by
+    # the metrics module's contract.
+    from distributed_model_parallel_tpu.observability.metrics import (
+        scan_emitted_names,
+    )
+
+    strays = scan_emitted_names()
+    if strays:
+        raise pytest.UsageError(
+            "every emitted span/metric name must be documented in "
+            "observability/metrics.py (TRACE_EVENT_NAMES / "
+            "METRIC_NAMES): "
+            + "; ".join(
+                f"{name} at {', '.join(sites)}"
+                for name, sites in sorted(strays.items())
+            )
+        )
+
     # slow-twin meta-check: group collected items by test function; a
     # function whose EVERY case is slow must document its tier-1 twin.
     # Only meaningful when whole files/dirs were collected: a direct

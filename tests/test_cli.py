@@ -612,23 +612,43 @@ def test_pipeline_schedule_flag_defaults():
         lm.main(["--pipeline-schedule", "1f1b"])  # no --pipeline-stages
 
 
-def test_serve_cli_replicated():
+def test_serve_cli_replicated(tmp_path):
     """The serving CLI end-to-end: synthetic trace in, per-request
     latencies + aggregate tokens/sec / p50/p99 legs out, slot
-    recycling under admission pressure (6 requests over 2 slots)."""
-    from distributed_model_parallel_tpu.cli import serve
+    recycling under admission pressure (6 requests over 2 slots),
+    plus the --metrics-out export (what tools/obsreport --metrics
+    ingests)."""
+    import json
 
-    result = serve.main([
-        "--dim", "16", "--layers", "2", "--heads", "4",
-        "--ffn-dim", "32", "--vocab-size", "61",
-        "--num-slots", "2", "--max-len", "16", "--prefill-len", "8",
-        "--num-requests", "6", "--prompt-len-min", "2",
-        "--prompt-len-max", "6", "--max-new-tokens", "3",
-    ])
+    from distributed_model_parallel_tpu.cli import serve
+    from distributed_model_parallel_tpu.observability import metrics
+
+    mpath = tmp_path / "metrics.json"
+    try:
+        result = serve.main([
+            "--dim", "16", "--layers", "2", "--heads", "4",
+            "--ffn-dim", "32", "--vocab-size", "61",
+            "--num-slots", "2", "--max-len", "16", "--prefill-len", "8",
+            "--num-requests", "6", "--prompt-len-min", "2",
+            "--prompt-len-max", "6", "--max-new-tokens", "3",
+            "--metrics-out", str(mpath),
+        ])
+    finally:
+        metrics.set_metrics(None)  # --metrics-out enabled the global
     assert result["serving"]["requests"] == 6
     assert result["serving"]["generated_tokens"] == 18
     assert result["serving"]["decode_p50_ms"] is not None
     assert len(result["requests"]) == 6
+    with open(mpath) as f:
+        exported = json.load(f)
+    assert {
+        "serve_queued_s", "serve_ttft_s", "serve_token_s",
+    } <= set(exported["histograms"])
+    assert exported["histograms"]["serve_ttft_s"]["count"] == 6
+    assert exported["gauges"]["serve_goodput"] > 0
+    # The counter totals to the report's generated_tokens exactly
+    # (prefill's first token + one per active slot per decode step).
+    assert exported["counters"]["serve_tokens_total"] == 18
 
 
 @pytest.mark.slow

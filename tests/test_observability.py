@@ -12,7 +12,11 @@ import os
 import numpy as np
 import pytest
 
-from distributed_model_parallel_tpu.observability import cost, trace
+from distributed_model_parallel_tpu.observability import (
+    cost,
+    metrics,
+    trace,
+)
 from distributed_model_parallel_tpu.observability.costgate import (
     gate_check,
     make_ledger,
@@ -335,6 +339,8 @@ def test_trainer_phase_spans_smoke(tmp_path, devices):
 
     tracer = trace.Tracer(enabled=True)
     trace.set_tracer(tracer)
+    reg = metrics.MetricsRegistry(enabled=True)
+    metrics.set_metrics(reg)
     try:
         mesh = make_mesh(MeshSpec(data=2), devices=devices[:2])
         engine = DataParallelEngine(tiny_cnn(10), SGD(), mesh)
@@ -361,8 +367,47 @@ def test_trainer_phase_spans_smoke(tmp_path, devices):
             "fetch", "step", "sync", "checkpoint_blocked",
             "ckpt_snapshot", "ckpt_background_write",
         } <= names
+        # The metrics registry mirrors the phases as distributions
+        # (tentpole wiring: step-time / fetch / checkpoint-blocked
+        # histograms plus the checkpoint writer pair).
+        exported = reg.to_json()
+        assert {
+            "train_fetch_s", "train_step_s",
+            "train_checkpoint_blocked_s", "ckpt_snapshot_s",
+            "ckpt_background_write_s",
+        } <= set(exported["histograms"])
+        assert reg.histogram("train_step_s").count == 2
+        assert exported["counters"]["train_batches_total"] == 2
+        # And the REAL CPU-mesh trace renders through obsreport: the
+        # attribution covers the trainer+checkpoint phases, the
+        # residual is finite, and the measured-vs-predicted row keys
+        # on a live ledger combo (acceptance: the report pipeline
+        # works on an actual run, not just the canned golden).
+        from distributed_model_parallel_tpu.observability import (
+            attribution,
+            report,
+        )
+        from distributed_model_parallel_tpu.observability.costgate import (
+            DEFAULT_LEDGER,
+            load_ledger,
+        )
+
+        chrome = tracer.to_chrome()
+        attr = attribution.attribute(chrome)
+        assert {"fetch", "step", "sync", "checkpoint_blocked"} <= {
+            p.name for p in attr.phases
+        }
+        assert 0.0 <= attr.residual_share < 1.0
+        rendered = report.render_report(
+            chrome, metrics=exported, ledger=load_ledger(DEFAULT_LEDGER),
+            combos=["ddp/S4/dcn2/bucketed"],
+        )
+        assert "unattributed residual" in rendered
+        assert "ddp/S4/dcn2/bucketed" in rendered
+        assert "train_step_s" in rendered
     finally:
         trace.set_tracer(None)
+        metrics.set_metrics(None)
 
 
 def test_serving_telemetry_and_request_spans(devices):
@@ -381,6 +426,8 @@ def test_serving_telemetry_and_request_spans(devices):
 
     tracer = trace.Tracer(enabled=True)
     trace.set_tracer(tracer)
+    reg = metrics.MetricsRegistry(enabled=True)
+    metrics.set_metrics(reg)
     try:
         cfg = GPTConfig(
             vocab_size=32, dim=16, num_layers=1, num_heads=2,
@@ -420,8 +467,25 @@ def test_serving_telemetry_and_request_spans(devices):
         assert len({
             e["tid"] for e in events if e["name"] == "queued"
         }) == 3
+        # Serving metrics wiring: per-request histograms through the
+        # scheduler, per-call histograms through the engine, goodput /
+        # occupancy as gauges, generated tokens as a counter.
+        exported = reg.to_json()
+        assert {
+            "serve_queued_s", "serve_ttft_s", "serve_token_s",
+            "serve_prefill_s", "serve_decode_step_s",
+        } <= set(exported["histograms"])
+        assert exported["histograms"]["serve_ttft_s"]["count"] == 3
+        assert exported["histograms"]["serve_token_s"]["count"] == sum(
+            len(f.tokens) - 1 for f in sched.finished
+        )
+        assert exported["gauges"]["serve_goodput"] == rep["goodput"]
+        assert exported["counters"]["serve_tokens_total"] == sum(
+            len(f.tokens) for f in sched.finished
+        ) == rep["generated_tokens"]
     finally:
         trace.set_tracer(None)
+        metrics.set_metrics(None)
 
 
 def test_scheduler_request_spans_coherent_under_injected_clock():
@@ -471,3 +535,111 @@ def test_serve_cli_trace_out_missing_dir_fails_fast():
             "--num-requests", "1",
         ])
     assert "does not exist" in str(exc.value)
+
+
+def test_serve_cli_metrics_out_missing_dir_fails_fast():
+    """--metrics-out shares --trace-out's fail-fast contract: a
+    mistyped directory must not surface as a lost export after the
+    whole run."""
+    from distributed_model_parallel_tpu.cli import serve
+
+    with pytest.raises(SystemExit) as exc:
+        serve.main([
+            "--metrics-out", "/no/such/dir/anywhere/metrics.json",
+            "--num-requests", "1",
+        ])
+    assert "does not exist" in str(exc.value)
+
+
+def test_progress_print_never_measures_its_own_readback_stall(
+    monkeypatch, devices,
+):
+    """The RESULTS §2 fence fix, regression-pinned with an injected
+    slow clock: every `jax.device_get` of the JUST-dispatched group's
+    metrics advances the fake clock by 10 s (the readback stall of
+    fencing in-flight compute). Because the progress print reads the
+    PREVIOUS group's metrics through the one-deep snapshot seam — and
+    the step-time sample closes BEFORE the print's fetch — at most the
+    first print's no-predecessor fallback can land a stall in the
+    train_step_s histogram. The pre-fix loop (fetching the current
+    group at every print) puts one in every window after the first."""
+    import jax
+
+    from distributed_model_parallel_tpu.models.tinycnn import tiny_cnn
+    from distributed_model_parallel_tpu.parallel.data_parallel import (
+        DataParallelEngine,
+    )
+    from distributed_model_parallel_tpu.runtime.mesh import (
+        MeshSpec,
+        make_mesh,
+    )
+    from distributed_model_parallel_tpu.training.optim import SGD
+    from distributed_model_parallel_tpu.training.trainer import (
+        Trainer,
+        TrainerConfig,
+    )
+
+    class TickClock:
+        def __init__(self):
+            self.t = 0.0
+
+        def __call__(self):
+            self.t += 1e-3
+            return self.t
+
+    clock = TickClock()
+    trace.set_tracer(trace.Tracer(clock=clock))  # tracing stays OFF
+    reg = metrics.MetricsRegistry(enabled=True)
+    metrics.set_metrics(reg)
+    try:
+        mesh = make_mesh(MeshSpec(data=2), devices=devices[:2])
+        engine = DataParallelEngine(tiny_cnn(10), SGD(), mesh)
+        rng = np.random.RandomState(0)
+        batches = [
+            (
+                rng.rand(8, 8, 8, 3).astype(np.float32),
+                rng.randint(0, 10, 8).astype(np.int32),
+            )
+            for _ in range(4)
+        ]
+        cfg = TrainerConfig(
+            epochs=1, print_freq=1, save_best=False,
+        )
+        trainer = Trainer(engine, batches, None, cfg,
+                          rng=jax.random.PRNGKey(0))
+
+        latest = []
+        orig_step = engine.train_step
+
+        def recording_step(state, *a):
+            state, m = orig_step(state, *a)
+            latest.append(m)
+            return state, m
+
+        monkeypatch.setattr(engine, "train_step", recording_step)
+        orig_get = jax.device_get
+
+        def slow_get(tree):
+            # Fetching the newest dispatch's metrics = fencing the
+            # in-flight compute: charge the injected stall. Anything
+            # older already finished behind the newer dispatch.
+            if latest and tree is latest[-1]:
+                clock.t += 10.0
+            return orig_get(tree)
+
+        monkeypatch.setattr(jax, "device_get", slow_get)
+        trainer.train_epoch(0)
+        hist = reg.histogram("train_step_s")
+        assert hist is not None and hist.count == 4
+        samples = hist._samples
+        stalled = sum(1 for s in samples if s > 5.0)
+        assert stalled <= 1, (
+            f"step-time histogram measured its own readback stall: "
+            f"{samples}"
+        )
+        # And the fix costs nothing at the tail: the LAST window is
+        # always stall-free.
+        assert samples[-1] < 5.0
+    finally:
+        trace.set_tracer(None)
+        metrics.set_metrics(None)

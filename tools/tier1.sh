@@ -100,6 +100,27 @@ echo "[tier1] costgate pre-gate ok:" \
   "$(grep -ac '"partial": true' /tmp/_t1_costgate.log || true)" \
   "combo(s) priced within tolerance"
 
+# obsreport pre-gate (the measured twin of the costgate pre-gate):
+# render the canned golden trace + metrics + ledger through the
+# jax-free report pipeline (observability/report.py) and byte-compare
+# against tests/golden/obsreport_report.txt — broken attribution /
+# quantile / reconciliation semantics fail in under a second with the
+# first diverging line printed. Exit 5 distinguishes a report
+# regression from a cost regression (4), a contract violation (3) and
+# a collection failure (2).
+rm -f /tmp/_t1_obsreport.log
+if ! timeout -k 5 60 bash tools/obsreport --pregate \
+    > /tmp/_t1_obsreport.log 2>&1; then
+  echo "[tier1] OBSREPORT PRE-GATE FAILED — the golden run report" \
+    "drifted (tools/obsreport, INTERNALS.md section 14):"
+  grep -aE "FAIL|obsreport|want:|got:" /tmp/_t1_obsreport.log | head -20
+  echo DOTS_PASSED=0
+  exit 5
+fi
+echo "[tier1] obsreport pre-gate ok:" \
+  "$(grep -aco '"pregate": "ok"' /tmp/_t1_obsreport.log || true)" \
+  "golden report byte-stable"
+
 rm -f /tmp/_t1.log
 timeout -k 10 870 env JAX_PLATFORMS=cpu \
     python -m pytest tests/ -q -m 'not slow' \
