@@ -651,7 +651,50 @@ def run_child_scaling(max_devices: int, model_name: str = "tinycnn",
     print(json.dumps(out, indent=2))
 
 
-def run_child_cm(max_devices: int, platform: str = "cpu") -> None:
+def _bench_plan(plan_path, families, sweep):
+    """(knobs, combo name) from a tuner plan.json (`tuning/plan.py`),
+    or (None, None) — the microbench children time the tuned
+    configuration as an extra row next to their default-knob rows.
+    The plan's engine family must match the sweep: a cross-family
+    plan's knobs would silently default-fill and the committed BENCH
+    artifact would label an unrelated timing as 'tuned'."""
+    if not plan_path:
+        return None, None
+    from distributed_model_parallel_tpu.tuning.plan import load_plan
+
+    plan = load_plan(plan_path)
+    family = plan["cell"]["family"]
+    if family not in families:
+        raise SystemExit(
+            f"--plan {plan_path}: plan cell.family is {family!r} but "
+            f"the {sweep} sweep times the "
+            f"{'/'.join(families)} famil"
+            f"{'ies' if len(families) > 1 else 'y'} — pass the "
+            "matching microbench (or the matching plan)"
+        )
+    return plan["knobs"], plan["combo"]
+
+
+def _tuned_row(axis_size: int, knobs, combo, tuned_ms: float,
+               default_ms: float, default_leg: str) -> dict:
+    """The tuned extra row: `tuned_vs_default_pct` > 0 means the tuned
+    configuration beat the table's default-knob leg."""
+    return {
+        "axis_size": axis_size,
+        "tuned": True,
+        "plan_combo": combo,
+        "knobs": dict(knobs),
+        "tuned_ms": round(tuned_ms, 3),
+        "default_leg": default_leg,
+        "default_ms": default_ms,
+        "tuned_vs_default_pct": round(
+            (default_ms - tuned_ms) / max(default_ms, 1e-9) * 100.0, 2
+        ),
+    }
+
+
+def run_child_cm(max_devices: int, platform: str = "cpu",
+                 plan_path=None) -> None:
     """Naive-vs-overlapped collective-matmul microbench — the pjit
     microbenchmark TODO from SNIPPETS [2], pointed at the latency-hiding
     rings (`ops/collective_matmul.py`).
@@ -716,6 +759,9 @@ def run_child_cm(max_devices: int, platform: str = "cpu") -> None:
         _ = jax.device_get(out.ravel()[0])  # real completion barrier
         return (time.perf_counter() - t0) / iters * 1e3
 
+    plan_knobs, plan_combo = _bench_plan(
+        plan_path, ("tp", "sp_lm"), "collective-matmul"
+    )
     rows = []
     for size in sizes:
         mesh = Mesh(np.array(devices[:size]), ("model",))
@@ -780,6 +826,20 @@ def run_child_cm(max_devices: int, platform: str = "cpu") -> None:
         # Per-leg partial line (same convention as the scaling sweep):
         # a wedge mid-sweep keeps the finished axis sizes.
         print(json.dumps({"leg": row, "partial": True}), flush=True)
+        if plan_knobs is not None:
+            tuned_fn = gradded(
+                ring if plan_knobs.get("collective_matmul") else mono
+            )
+            trow = _tuned_row(
+                size, plan_knobs, plan_combo,
+                time_fn(tuned_fn, (x, w1, w2)),
+                row["step_naive_ms"], "step_naive_ms",
+            )
+            rows.append(trow)
+            log(f"S={size} tuned: {trow['tuned_ms']}ms "
+                f"({trow['tuned_vs_default_pct']:+.1f}% vs naive)")
+            print(json.dumps({"leg": trow, "partial": True}),
+                  flush=True)
 
     out = {
         "collective_matmul_microbench": rows,
@@ -799,7 +859,8 @@ def run_child_cm(max_devices: int, platform: str = "cpu") -> None:
     print(json.dumps(out, indent=2))
 
 
-def run_child_reducer(max_devices: int, platform: str = "cpu") -> None:
+def run_child_reducer(max_devices: int, platform: str = "cpu",
+                      plan_path=None) -> None:
     """Naive-vs-bucketed-vs-hierarchical gradient-reduction microbench
     (`ops/grad_reduction.py`) — the reducer counterpart of the
     collective-matmul table.
@@ -962,6 +1023,9 @@ def run_child_reducer(max_devices: int, platform: str = "cpu") -> None:
         fence(out)
         return (time.perf_counter() - t0) / iters * 1e3
 
+    plan_knobs, plan_combo = _bench_plan(
+        plan_path, ("ddp", "fsdp", "sp_lm"), "reducer"
+    )
     rows = []
     for size in sizes:
         flat_mesh = Mesh(np.array(devices[:size]), ("data",))
@@ -1054,6 +1118,44 @@ def run_child_reducer(max_devices: int, platform: str = "cpu") -> None:
                 f"{wrow['hierarchical_ms']}ms")
             print(json.dumps({"leg": wrow, "partial": True}),
                   flush=True)
+        if plan_knobs is not None:
+            # The tuned configuration as an extra row on the same
+            # hierarchical harness: the plan's bucket cap + wire on
+            # the bucket-ring reduction ('overlapped' times its
+            # bucket structure — this harness is the pure reduction;
+            # uncompressed 'monolithic' is the fused tree pmean,
+            # compressed monolithic the engines' single flat bucket).
+            gr = plan_knobs.get("grad_reduction", "monolithic")
+            twire = plan_knobs.get("dcn_compression", "none")
+            if gr == "monolithic" and twire == "none":
+                tuned = reducer(
+                    hier_mesh,
+                    lambda t: jax.tree_util.tree_map(
+                        lambda g: lax.pmean(g, ("dcn", "ici")), t
+                    ),
+                )
+            else:
+                tuned = reducer(
+                    hier_mesh,
+                    partial(
+                        bucketed_pmean, ici_axis="ici",
+                        dcn_axis="dcn",
+                        bucket_mb=(
+                            plan_knobs.get("bucket_mb") or 1e9
+                        ),
+                        dcn_compression=twire,
+                    ),
+                )
+            trow = _tuned_row(
+                size, plan_knobs, plan_combo, time_fn(tuned),
+                row["hierarchical_ms"], "hierarchical_ms",
+            )
+            rows.append(trow)
+            log(f"S={size} tuned: {trow['tuned_ms']}ms "
+                f"({trow['tuned_vs_default_pct']:+.1f}% vs "
+                "hierarchical)")
+            print(json.dumps({"leg": trow, "partial": True}),
+                  flush=True)
 
     out = {
         "reducer_microbench": rows,
@@ -1082,7 +1184,8 @@ def run_child_reducer(max_devices: int, platform: str = "cpu") -> None:
     print(json.dumps(out, indent=2))
 
 
-def run_child_moe(max_devices: int, platform: str = "cpu") -> None:
+def run_child_moe(max_devices: int, platform: str = "cpu",
+                  plan_path=None) -> None:
     """Flat-vs-hierarchical-vs-overlapped MoE dispatch microbench
     (`ops/expert_dispatch.py`) — the expert-exchange counterpart of the
     reducer table.
@@ -1177,6 +1280,7 @@ def run_child_moe(max_devices: int, platform: str = "cpu") -> None:
         y = expert_ffn(wl, z)
         return flat_expert_return(y, dd)
 
+    plan_knobs, plan_combo = _bench_plan(plan_path, ("ep",), "MoE")
     rows = []
     for size in sizes:
         flat_mesh = Mesh(np.array(devices[:size]), ("data",))
@@ -1267,6 +1371,32 @@ def run_child_moe(max_devices: int, platform: str = "cpu") -> None:
                 f"{wrow['hierarchical_ms']}ms, overlapped "
                 f"{wrow['overlapped_ms']}ms")
             print(json.dumps({"leg": wrow, "partial": True}),
+                  flush=True)
+        if plan_knobs is not None:
+            # The tuned dispatch as an extra row: the plan's
+            # dispatch/overlap/wire knobs on the same exchange+FFN
+            # harness, vs the flat (GSPMD-shaped) default leg.
+            if plan_knobs.get("dispatch") == "gspmd":
+                tuned = flat
+            else:
+                tuned = build(
+                    hier_mesh, ("dcn", "ici"),
+                    partial(
+                        hier_body,
+                        overlap=bool(plan_knobs.get("overlap")),
+                        wire=plan_knobs.get(
+                            "dcn_compression", "none"
+                        ),
+                    ),
+                )
+            trow = _tuned_row(
+                size, plan_knobs, plan_combo, time_fn(tuned),
+                row["flat_ms"], "flat_ms",
+            )
+            rows.append(trow)
+            log(f"S={size} tuned: {trow['tuned_ms']}ms "
+                f"({trow['tuned_vs_default_pct']:+.1f}% vs flat)")
+            print(json.dumps({"leg": trow, "partial": True}),
                   flush=True)
 
     out = {
@@ -2021,6 +2151,14 @@ if __name__ == "__main__":
              "--max-devices",
     )
     parser.add_argument(
+        "--plan", default=None, metavar="PLAN.json",
+        help="time a tuner plan's chosen configuration "
+             "(tuning/plan.py, --auto-tune search's artifact) as an "
+             "extra row on the --reducer-microbench / --cm-microbench "
+             "/ --moe-microbench tables, with a tuned_vs_default_pct "
+             "column against the table's default-knob leg",
+    )
+    parser.add_argument(
         "--child", action="store_true",
         help="internal: run a measurement in-process (spawned by main)",
     )
@@ -2045,6 +2183,8 @@ if __name__ == "__main__":
     parser.add_argument("--child-checkpoint", action="store_true",
                         help="internal: run the checkpoint microbench "
                              "in-process")
+    parser.add_argument("--child-plan", default=None,
+                        help="internal: plan path for the tuned row")
     parser.add_argument("--child-model", default="mobilenetv2")
     parser.add_argument("--child-batch", type=int, default=512)
     parser.add_argument("--child-dtypes", default="bfloat16,float32")
@@ -2065,6 +2205,17 @@ if __name__ == "__main__":
             "per invocation; running several would silently drop "
             "tables)"
         )
+    if args.plan and not (
+        args.reducer_microbench or args.cm_microbench
+        or args.moe_microbench
+    ):
+        parser.error(
+            "--plan adds a tuned row to the reducer/cm/moe "
+            "microbenches; pass one of --reducer-microbench / "
+            "--cm-microbench / --moe-microbench with it"
+        )
+    if args.plan and not os.path.isfile(args.plan):
+        parser.error(f"--plan: no such file {args.plan!r}")
 
     if args.child_probe:
         run_child_probe()
@@ -2078,13 +2229,16 @@ if __name__ == "__main__":
                           args.scaling_platform)
         sys.exit(0)
     if args.child_cm:
-        run_child_cm(args.max_devices, args.scaling_platform)
+        run_child_cm(args.max_devices, args.scaling_platform,
+                     args.child_plan)
         sys.exit(0)
     if args.child_reducer:
-        run_child_reducer(args.max_devices, args.scaling_platform)
+        run_child_reducer(args.max_devices, args.scaling_platform,
+                          args.child_plan)
         sys.exit(0)
     if args.child_moe:
-        run_child_moe(args.max_devices, args.scaling_platform)
+        run_child_moe(args.max_devices, args.scaling_platform,
+                      args.child_plan)
         sys.exit(0)
     if args.child_serving:
         run_child_serving(args.max_devices, args.scaling_platform)
@@ -2122,21 +2276,27 @@ if __name__ == "__main__":
                 _run_sweep_child(
                     ["--child-cm",
                      "--max-devices", str(args.max_devices),
-                     "--scaling-platform", args.scaling_platform],
+                     "--scaling-platform", args.scaling_platform]
+                    + (["--child-plan", args.plan] if args.plan
+                       else []),
                     env, "collective_matmul_microbench",
                 )
             elif args.reducer_microbench:
                 _run_sweep_child(
                     ["--child-reducer",
                      "--max-devices", str(args.max_devices),
-                     "--scaling-platform", args.scaling_platform],
+                     "--scaling-platform", args.scaling_platform]
+                    + (["--child-plan", args.plan] if args.plan
+                       else []),
                     env, "reducer_microbench",
                 )
             elif args.moe_microbench:
                 _run_sweep_child(
                     ["--child-moe",
                      "--max-devices", str(args.max_devices),
-                     "--scaling-platform", args.scaling_platform],
+                     "--scaling-platform", args.scaling_platform]
+                    + (["--child-plan", args.plan] if args.plan
+                       else []),
                     env, "moe_microbench",
                 )
             elif args.serving_microbench:

@@ -61,6 +61,13 @@ class Combo:
     # MoE dispatch (`ops/wire_codec.py`, rule dcn-compressed-payload).
     dcn_compression: str = "none"
 
+    # Tuner-searched reducer knobs (`tuning/`): an explicit bucket cap
+    # (None = this module's BUCKET_MB, keeping every pre-existing combo
+    # name and ledger row byte-stable) and an explicit stagewise
+    # segment count (0 = the engines' auto default).
+    bucket_mb: Optional[float] = None
+    overlap_stages: int = 0
+
     @property
     def name(self) -> str:
         bits = [self.engine, f"S{self.size}"]
@@ -74,6 +81,10 @@ class Combo:
                 bits.append("ov")
         if self.dcn_compression != "none":
             bits.append(f"wire-{self.dcn_compression}")
+        if self.bucket_mb is not None:
+            bits.append(f"b{self.bucket_mb:g}")
+        if self.overlap_stages:
+            bits.append(f"seg{self.overlap_stages}")
         if self.model != "mlp":
             bits.append(self.model)
         if self.collective_matmul:
@@ -212,7 +223,8 @@ def _bucket_plan(leaves, bucket_mb: float, pad_multiple: int):
 
 def _reducer_plans(model, grad_reduction: str, bucket_mb: float,
                    ici_size: int, dcn_size: int = 1,
-                   dcn_compression: str = "none"):
+                   dcn_compression: str = "none",
+                   overlap_stages: int = 0):
     """Per-segment bucket plans + segment count for a staged model —
     one segment for 'bucketed', split_points segments for
     'overlapped', one WHOLE-TREE bucket per dtype for compressed
@@ -247,7 +259,7 @@ def _reducer_plans(model, grad_reduction: str, bucket_mb: float,
         return plans, 0, state_shapes
     if grad_reduction == "overlapped":
         n = staging.resolve_overlap_segments(
-            len(model.parts.blocks), 0, "lint"
+            len(model.parts.blocks), overlap_stages, "lint"
         )
         cuts = staging.split_points(n, None, len(model.parts.blocks))
         plans = tuple(
@@ -393,6 +405,7 @@ def _build_data_engine(combo: Combo, devices):
         model = staged_mlp(width=128 if combo.engine == "fsdp" else 32)
     cdt = jnp.bfloat16 if combo.bf16 else None
     kwargs = dict(donate=True, compute_dtype=cdt)
+    bmb = BUCKET_MB if combo.bucket_mb is None else combo.bucket_mb
     full_leaf_shapes: Tuple = ()
     if combo.engine == "dp":
         from distributed_model_parallel_tpu.parallel.data_parallel import (
@@ -407,7 +420,7 @@ def _build_data_engine(combo: Combo, devices):
 
         eng = DDPEngine(
             model, SGD(), mesh, grad_reduction=combo.grad_reduction,
-            bucket_mb=BUCKET_MB,
+            bucket_mb=bmb, overlap_stages=combo.overlap_stages,
             dcn_compression=combo.dcn_compression, **kwargs,
         )
     else:  # fsdp
@@ -421,7 +434,8 @@ def _build_data_engine(combo: Combo, devices):
         min_elems = 64
         eng = FSDPEngine(
             model, SGD(), mesh, min_shard_elems=min_elems,
-            grad_reduction=combo.grad_reduction, bucket_mb=BUCKET_MB,
+            grad_reduction=combo.grad_reduction, bucket_mb=bmb,
+            overlap_stages=combo.overlap_stages,
             dcn_compression=combo.dcn_compression, **kwargs,
         )
         from jax.sharding import PartitionSpec as P
@@ -443,8 +457,9 @@ def _build_data_engine(combo: Combo, devices):
         full_leaf_shapes = tuple(shapes)
 
     plans, n_seg, state_shapes = _reducer_plans(
-        model, combo.grad_reduction, BUCKET_MB, facts["ici_size"],
+        model, combo.grad_reduction, bmb, facts["ici_size"],
         facts["dcn_size"], combo.dcn_compression,
+        combo.overlap_stages,
     )
     ts = eng.init_state(jax.random.PRNGKey(0))
     im, lb = eng.shard_batch(*image_batch(16 * (s // 2 or 1)))
@@ -601,9 +616,11 @@ def _build_sp_lm(combo: Combo, devices):
     )
     facts = _mesh_facts(mesh)
     cfg = _gpt_cfg()
+    bmb = BUCKET_MB if combo.bucket_mb is None else combo.bucket_mb
     eng = CausalLMSequenceParallelEngine(
         cfg, SGD(), mesh, donate=True,
-        grad_reduction=combo.grad_reduction, bucket_mb=BUCKET_MB,
+        grad_reduction=combo.grad_reduction, bucket_mb=bmb,
+        overlap_stages=combo.overlap_stages,
         collective_matmul=combo.collective_matmul,
         dcn_compression=combo.dcn_compression,
     )
@@ -620,8 +637,9 @@ def _build_sp_lm(combo: Combo, devices):
     # expectation builder serves it like the image engines (one copy
     # of the monolithic-compressed/bucketed/overlapped plan logic).
     plans, n_seg, _ = _reducer_plans(
-        gpt_lm(cfg), combo.grad_reduction, BUCKET_MB,
+        gpt_lm(cfg), combo.grad_reduction, bmb,
         facts["ici_size"], facts["dcn_size"], combo.dcn_compression,
+        combo.overlap_stages,
     )
     dcn_records = (
         jaxpr_ppermute_records(eng.train_step, ts, ids, tg,

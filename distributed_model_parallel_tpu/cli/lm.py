@@ -143,6 +143,11 @@ def build_parser() -> argparse.ArgumentParser:
                         "--seq-shards)")
     add_grad_reduction_flags(p)
     add_checkpoint_flags(p)
+    from distributed_model_parallel_tpu.tuning.apply import (
+        add_auto_tune_flags,
+    )
+
+    add_auto_tune_flags(p)
     p.add_argument("--dtype", default="float32",
                    choices=("float32", "bfloat16"))
     p.add_argument("--remat", action="store_true")
@@ -169,6 +174,15 @@ def main(argv=None) -> dict:
 
     setup_metrics_out(args.metrics_out)  # fail fast on a bad directory
     initialize_backend()
+    if args.auto_tune:
+        # BEFORE the knob guards below: the tuner writes the chosen
+        # knobs onto args, and an inconsistent plan must still hit
+        # every existing fail-fast check.
+        from distributed_model_parallel_tpu.tuning.apply import (
+            auto_tune_lm,
+        )
+
+        auto_tune_lm(args)
     if args.pipeline_stages > 1 and args.seq_shards > 1:
         raise SystemExit(
             "--pipeline-stages and --seq-shards are mutually exclusive "

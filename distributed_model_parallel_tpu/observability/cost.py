@@ -132,7 +132,31 @@ def fabrics_from_constants(
 #
 # The scaling64 §3 formulas as functions. Arguments are payload bytes
 # (or elements for the dtype-scaled MoE wire rows), axis sizes, and the
-# bucket/op counts the alpha terms multiply.
+# bucket/op counts the alpha terms multiply. Each multi-fabric form
+# takes an optional `constants` dict (the CONSTANTS key set) so the
+# tuner can score candidates under a loaded calibration instead of the
+# hand block; None keeps the module constants.
+
+
+def _resolve_constants(constants: Optional[Dict[str, float]]):
+    """(bw_ici, alpha_ici, bw_dcn, alpha_dcn) under explicit constants
+    (validated against the CONSTANTS key set) or the hand block."""
+    if constants is None:
+        return (BW_ICI_EFFECTIVE, ALPHA_HOP_S, BW_DCN_EFFECTIVE,
+                ALPHA_DCN_HOP_S)
+    missing = sorted(set(CONSTANTS) - set(constants))
+    if missing:
+        raise ValueError(
+            f"constants set is missing {', '.join(missing)} — pass a "
+            "full CONSTANTS-shaped dict (cost.load_calibration "
+            "validates calibration files into one)"
+        )
+    return (
+        constants["bw_ici_effective_bytes_per_s"],
+        constants["alpha_hop_s"],
+        constants["bw_dcn_effective_bytes_per_s"],
+        constants["alpha_dcn_hop_s"],
+    )
 
 
 def ring_all_reduce_s(nbytes: float, size: int, n_ops: int = 1,
@@ -149,58 +173,67 @@ def ring_all_reduce_s(nbytes: float, size: int, n_ops: int = 1,
 
 def two_level_all_reduce_s(nbytes: float, ici: int, dcn: int,
                            n_buckets: int = 1,
-                           wire: str = "none") -> float:
+                           wire: str = "none",
+                           constants: Optional[Dict[str, float]] = None,
+                           ) -> float:
     """Hierarchical bucketed reduction over a dcn x ici fabric (§3b /
     §3b'): ring reduce-scatter + all-gather over 'ici' at the full
     payload, the 1/ici shard across 'dcn' — at the wire itemsize when
     compressed (int8 adds one sidecar hop per payload hop, counted in
     alpha; its 4-byte scale payload is noise and not priced)."""
+    bw_ici, a_ici, bw_dcn, a_dcn = _resolve_constants(constants)
     wb = WIRE_ITEMSIZE[wire]
     sidecar_hops = 1 if wire == "int8" else 0
-    beta = 2 * (ici - 1) / ici * nbytes / BW_ICI_EFFECTIVE
+    beta = 2 * (ici - 1) / ici * nbytes / bw_ici
     if dcn > 1:
         beta += (
             2 * (dcn - 1) / dcn * (nbytes / ici) * (wb / 4)
-            / BW_DCN_EFFECTIVE
+            / bw_dcn
         )
     alpha = n_buckets * (
-        2 * (ici - 1) * ALPHA_HOP_S
-        + (1 + sidecar_hops) * 2 * (dcn - 1) * ALPHA_DCN_HOP_S
+        2 * (ici - 1) * a_ici
+        + (1 + sidecar_hops) * 2 * (dcn - 1) * a_dcn
     )
     return beta + alpha
 
 
 def flat_all_to_all_s(elems: int, itemsize: int, ici: int,
-                      dcn: int) -> float:
+                      dcn: int,
+                      constants: Optional[Dict[str, float]] = None,
+                      ) -> float:
     """One flat (partitioner-shaped) token exchange over the joint
     dcn x ici fabric (§3c): (K-1)/K of the payload crosses the slice
     boundary in (K-1)*I fragments; the intra-slice share rides ICI."""
+    bw_ici, a_ici, bw_dcn, a_dcn = _resolve_constants(constants)
     x_bytes = elems * itemsize
     n = ici * dcn
     return (
-        (dcn - 1) / dcn * x_bytes / BW_DCN_EFFECTIVE
-        + (ici - 1) / n * x_bytes / BW_ICI_EFFECTIVE
-        + (dcn - 1) * ici * ALPHA_DCN_HOP_S
-        + (ici - 1) * ALPHA_HOP_S
+        (dcn - 1) / dcn * x_bytes / bw_dcn
+        + (ici - 1) / n * x_bytes / bw_ici
+        + (dcn - 1) * ici * a_dcn
+        + (ici - 1) * a_ici
     )
 
 
 def hierarchical_all_to_all_s(elems: int, itemsize: int, ici: int,
                               dcn: int,
-                              wire: Optional[str] = None) -> float:
+                              wire: Optional[str] = None,
+                              constants: Optional[
+                                  Dict[str, float]] = None) -> float:
     """One two-level token exchange (§3c / §3c',
     `ops/expert_dispatch.py`): same cross-slice bytes as the flat form
     but in K-1 contiguous messages of the 1/ici-regrouped shard — at
     the wire itemsize when compressed — and the intra-slice share on
     ICI exclusively."""
+    bw_ici, a_ici, bw_dcn, a_dcn = _resolve_constants(constants)
     x_bytes = elems * itemsize
     dcn_itemsize = itemsize if wire in (None, "none") \
         else WIRE_ITEMSIZE[wire]
     return (
-        (dcn - 1) / dcn * (elems * dcn_itemsize) / BW_DCN_EFFECTIVE
-        + (ici - 1) / ici * x_bytes / BW_ICI_EFFECTIVE
-        + (dcn - 1) * ALPHA_DCN_HOP_S
-        + (ici - 1) * ALPHA_HOP_S
+        (dcn - 1) / dcn * (elems * dcn_itemsize) / bw_dcn
+        + (ici - 1) / ici * x_bytes / bw_ici
+        + (dcn - 1) * a_dcn
+        + (ici - 1) * a_ici
     )
 
 
@@ -275,13 +308,18 @@ def predict_collectives(
     collectives: Sequence[ClassifiedCollective],
     mesh: MeshModel,
     dcn_axis: Optional[str] = None,
+    fabrics: Optional["tuple[Fabric, Fabric]"] = None,
 ) -> CostBreakdown:
     """Price every classified collective and sum. Fabric assignment is
     the mesh's: a collective whose membership crosses `dcn_axis` is
     priced on DCN (the slow fabric gates it); everything else rides
     ICI. Unclassifiable membership (axes=None) is conservatively priced
     as crossing every non-trivial axis — the same worst-case answer the
-    lint rules give it."""
+    lint rules give it. `fabrics` = an explicit (ici, dcn) pair (e.g.
+    `fabrics_from_constants(load_calibration(...))` — the tuner's
+    measured-physics path); None keeps the hand constants."""
+    ici_fabric, dcn_fabric = fabrics if fabrics is not None \
+        else (ICI, DCN)
     nontrivial = frozenset(
         a for a, s in zip(mesh.axis_names, mesh.shape) if s > 1
     )
@@ -290,8 +328,9 @@ def predict_collectives(
         axes = c.axes if c.axes is not None else nontrivial
         if not axes:
             continue  # single-device membership: free
-        fabric = DCN if (dcn_axis is not None and dcn_axis in axes) \
-            else ICI
+        fabric = dcn_fabric \
+            if (dcn_axis is not None and dcn_axis in axes) \
+            else ici_fabric
         group = 1
         for a in axes:
             group *= mesh.size(a)
@@ -310,10 +349,12 @@ def predict_collectives(
     return out
 
 
-def combo_cost(combo, devices=None) -> dict:
+def combo_cost(combo, devices=None, constants=None) -> dict:
     """Lower ONE lint-matrix combo (reusing the lint driver's builders
     — the same model, mesh, and compiled HLO the rules judge) and
-    return its ledger row. Heavy: compiles on the virtual mesh."""
+    return its ledger row. Heavy: compiles on the virtual mesh.
+    `constants` (a CONSTANTS-shaped dict, e.g. a loaded calibration)
+    swaps the pricing physics; the lowering is unchanged."""
     from distributed_model_parallel_tpu.analysis.hlo import parse_hlo
     from distributed_model_parallel_tpu.analysis.collectives import (
         classify,
@@ -324,7 +365,9 @@ def combo_cost(combo, devices=None) -> dict:
     mesh_model = MeshModel.from_mesh(mesh)
     collectives = classify(parse_hlo(hlo), mesh_model)
     breakdown = predict_collectives(
-        collectives, mesh_model, target.dcn_axis
+        collectives, mesh_model, target.dcn_axis,
+        fabrics=fabrics_from_constants(constants)
+        if constants is not None else None,
     )
     return breakdown.as_row()
 

@@ -199,13 +199,29 @@ def main(argv: Optional[List[str]] = None) -> int:
     parser.add_argument("--devices", type=int, default=8)
     parser.add_argument(
         "--calibration", default=None, metavar="JSON",
-        help="report (never gate on) the drift between the fitted "
-             "constants in this calibration.json "
-             "(observability/calibrate.py) and the committed hand "
-             "constants — measured physics informs the model; the "
-             "gate stays a structural check on the lowered program",
+        help="report the drift between the fitted constants in this "
+             "calibration.json (observability/calibrate.py) and the "
+             "committed hand constants — measured physics informs the "
+             "model; by default the gate stays a structural check on "
+             "the lowered program (see --calibration-tolerance)",
+    )
+    parser.add_argument(
+        "--calibration-tolerance", type=float, default=None,
+        metavar="PCT",
+        help="upgrade calibration drift beyond this percentage (any "
+             "constant, either direction) to the exit-4 gate path, so "
+             "a stale committed calibration.json can fail CI once "
+             "opted in; default keeps the report-only behavior",
     )
     args = parser.parse_args(argv)
+
+    if args.calibration_tolerance is not None and not args.calibration:
+        print(
+            "[costgate] --calibration-tolerance gates the drift "
+            "report; pass --calibration JSON with it",
+            file=sys.stderr,
+        )
+        return 2
 
     calibration_drift = None
     if args.calibration:
@@ -223,12 +239,43 @@ def main(argv: Optional[List[str]] = None) -> int:
                   file=sys.stderr)
             return 2
         calibration_drift = drift_report(fitted)
+        gated_note = (
+            "reported, not gated"
+            if args.calibration_tolerance is None
+            else f"gated at {args.calibration_tolerance:g}%"
+        )
         for key, pct in calibration_drift.items():
             print(
-                f"[costgate] calibration drift (reported, not "
-                f"gated): {key} committed {CONSTANTS[key]:g} -> "
+                f"[costgate] calibration drift ({gated_note}): "
+                f"{key} committed {CONSTANTS[key]:g} -> "
                 f"fitted {fitted[key]:g} ({pct:+.1f}%)"
             )
+        if args.calibration_tolerance is not None:
+            drifted = sorted(
+                key for key, pct in calibration_drift.items()
+                if abs(pct) > args.calibration_tolerance
+            )
+            if drifted:
+                # Fail BEFORE any lowering: a stale calibration is a
+                # property of the committed artifact, not of this
+                # tree's programs — no compile can change the verdict.
+                for key in drifted:
+                    print(
+                        f"[costgate] FAIL calibration drift: {key} "
+                        f"{calibration_drift[key]:+.1f}% exceeds "
+                        f"--calibration-tolerance "
+                        f"{args.calibration_tolerance:g}% — refit "
+                        "(observability/calibrate.py) and re-commit "
+                        "experiments/calibration.json"
+                    )
+                print(json.dumps({"costgate": {
+                    "failures": len(drifted),
+                    "failed_targets": [
+                        f"calibration:{k}" for k in drifted
+                    ],
+                    "calibration_drift_pct": calibration_drift,
+                }}))
+                return EXIT_GATE_FAILED
 
     # Virtual CPU devices BEFORE any backend initializes (same guard as
     # tools/hlolint: this environment preloads a TPU PJRT plugin).

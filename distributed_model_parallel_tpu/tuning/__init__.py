@@ -1,0 +1,45 @@
+"""tuning/ — the cost-engine-driven auto-tuner (ROADMAP item 5's
+"what remains is the TUNER").
+
+Thirteen PRs grew performance knobs (`grad_reduction` mode, bucket_mb,
+overlap_stages, dcn_compression, collective_matmul, MoE dispatch/
+overlap) and two PRs built the physics to judge them
+(`observability/cost.py` prices every classified collective per combo;
+`observability/calibrate.py` fits the constants from measured bench
+legs). This package closes the loop: a declarative search space over
+the existing flag cross-product per engine family (`space.py`), a
+deterministic enumerate-and-score search that prunes with the
+closed-form alpha-beta formulas and REALLY lowers only the argmin
+finalists through `analysis/lint.lower_combo` (`search.py`), a
+versioned `plan.json` artifact both training CLIs accept via
+`--auto-tune PLAN|search` (`plan.py`, `apply.py`), and a
+costgate-style gate over the committed `experiments/tuned_plans.json`
+grid (`plangate.py`, `tools/plangate`, exit 6).
+
+Every applied plan is verified, not trusted: the search re-lowers the
+chosen configuration and runs hlolint's FULL rule registry over it, so
+a plan that picks `dcn_compression=int8` must actually produce
+`dcn-compressed-payload`-clean HLO before anyone trains under it.
+
+The same search-over-a-cost-model shape Megatron SC'21 uses to pick
+its parallel configuration (PAPERS.md, Narayanan) — here the model is
+calibrated against measured runs (`--auto-tune-calibration`), so
+candidates score against physics, not vibes.
+
+`space.py` and `plan.py` are jax-free by module contract (the conftest
+META-CHECK and the schema tooling must import without a backend);
+`search.py`'s heavy imports are function-local.
+"""
+
+from distributed_model_parallel_tpu.tuning.plan import (  # noqa: F401
+    Cell,
+    PLAN_SCHEMA,
+    load_plan,
+    save_plan,
+    validate_plan,
+)
+from distributed_model_parallel_tpu.tuning.space import (  # noqa: F401
+    SPACES,
+    candidates,
+    scan_knob_surface,
+)

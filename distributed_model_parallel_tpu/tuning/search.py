@@ -1,0 +1,396 @@
+"""Deterministic enumerate-and-score search over one tuning cell.
+
+Two-tier scoring, as the cost engine's shape demands:
+
+1. **Closed-form pruning** — every candidate in the cell's space is
+   priced with the alpha-beta closed forms (`observability/cost.py`,
+   the same formulas scaling64 §3 asserts against) over the cell's
+   payload model (gradient bytes from a jax.eval_shape of the lint
+   proxy — no compile). Cheap enough to score the whole cross-product.
+2. **Real lowering for the argmin finalists** — the K best-ranked
+   candidates are lowered through `analysis/lint.lower_combo` (the
+   SAME builders, models and meshes the hlolint rules and the costgate
+   ledger judge) and priced from their compiled HLO
+   (`cost.predict_collectives`). The argmin over the finalists is the
+   plan.
+
+The winner is then VERIFIED, not trusted: hlolint's full rule registry
+runs over the winning lowering, so a plan that picked
+`dcn_compression=int8` must actually produce
+`dcn-compressed-payload`-clean HLO — a violation raises
+`PlanLintError` naming the rule instead of emitting the plan.
+
+Determinism contract: candidates enumerate in `space.candidates`'s
+sorted order, ties break on `(score, space.preference, canonical
+key)`, predicted times come from the ledger-rounded `as_row()` form —
+two searches of the same cell produce byte-identical plans
+(`plan.dumps_plan`), which is what `tools/plangate` gates on.
+
+Both tiers price under the hand constants by default or an explicit
+CONSTANTS-shaped dict (a loaded calibration) — measured physics, same
+search. Heavy imports are function-local (module must import without a
+backend).
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from distributed_model_parallel_tpu.tuning import space as tspace
+from distributed_model_parallel_tpu.tuning.plan import Cell, make_plan
+
+#: How many closed-form-ranked candidates get REAL lowering. Generous
+#: relative to the spaces' plateau structure (the closed forms share
+#: the walker's constants and formulas, so the true argmin landing
+#: outside the top 4 would mean the closed form mis-ranks by more than
+#: the candidates differ — the brute-force pin in tests/test_tuning.py
+#: guards exactly that).
+DEFAULT_FINALISTS = 4
+
+
+class PlanLintError(RuntimeError):
+    """The searched argmin's lowering violates a collective contract —
+    the plan is NOT emitted (a tuner that ships physics-optimal but
+    contract-breaking configurations is worse than no tuner)."""
+
+
+# ----------------------------------------------------- payload models
+
+
+def cell_payload(cell: Cell) -> dict:
+    """The closed-form scorer's inputs for one cell, from the SAME lint
+    proxy models the finalists will really lower — gradient bytes and
+    block count via jax.eval_shape (no compile, no devices) for the
+    reducer families, the dispatch-buffer element count for ep. tp has
+    no closed-form payload (its two candidates are both lowered)."""
+    if cell.family == "tp":
+        return {}
+    import jax
+    import jax.numpy as jnp
+
+    from distributed_model_parallel_tpu.analysis import lint as L
+
+    if cell.family in ("ddp", "fsdp"):
+        if cell.model == "tinycnn":
+            from distributed_model_parallel_tpu.models.tinycnn import (
+                tiny_cnn,
+            )
+
+            model = tiny_cnn(4)
+        else:
+            model = L.staged_mlp(
+                width=128 if cell.family == "fsdp" else 32
+            )
+    elif cell.family == "sp_lm":
+        from distributed_model_parallel_tpu.models.gpt import gpt_lm
+
+        model = gpt_lm(L._gpt_cfg())
+    else:  # ep: the moe_classifier dispatch buffer, per device
+        ici = cell.size // cell.dcn
+        n = max(8, ici * cell.dcn)
+        seq, dim, top_k, cap = 8, 16, 2, 1.25
+        return {
+            "elems": int(
+                top_k * cap * (n * seq / cell.size) * dim
+            ),
+            "itemsize": 4,  # the lint classifier runs f32
+        }
+    key_aval = jax.ShapeDtypeStruct((2,), jnp.uint32)
+    p_aval, _ = jax.eval_shape(model.init, key_aval)
+    grad_bytes = sum(
+        int(math.prod(leaf.shape) or 1) * jnp.dtype(leaf.dtype).itemsize
+        for leaf in jax.tree_util.tree_leaves(p_aval)
+    )
+    return {
+        "grad_bytes": grad_bytes,
+        "n_blocks": len(model.parts.blocks),
+    }
+
+
+# --------------------------------------------------- closed-form tier
+
+
+def reducer_closed_form_s(knobs: dict, grad_bytes: int, ici: int,
+                          dcn: int, n_blocks: int = 4,
+                          constants: Optional[Dict[str, float]] = None,
+                          ) -> float:
+    """Predicted per-step gradient-reduction comm time for one reducer
+    candidate — the §3a/§3b/§3b' formulas keyed off the knobs. The
+    bucket count is the flat approximation ceil(bytes / bucket)
+    (dtype-grouping adds a bucket or two; ranking is unaffected), with
+    a floor of one bucket per stagewise segment under 'overlapped'."""
+    from distributed_model_parallel_tpu.observability import cost
+
+    gr = knobs["grad_reduction"]
+    wire = knobs["dcn_compression"]
+    size = ici * dcn
+    if gr == "monolithic":
+        if wire == "none":
+            bw_ici, a_ici, bw_dcn, a_dcn = cost._resolve_constants(
+                constants
+            )
+            if dcn > 1:
+                # One fused all-reduce whose membership crosses the
+                # slice boundary: the slow fabric gates the whole ring.
+                return cost.ring_all_reduce_s(
+                    grad_bytes, size, n_ops=1, bw=bw_dcn, alpha=a_dcn
+                )
+            return cost.ring_all_reduce_s(
+                grad_bytes, size, n_ops=1, bw=bw_ici, alpha=a_ici
+            )
+        # Compressed monolithic routes through ONE flat bucket
+        # (MONOLITHIC_BUCKET_MB) — hierarchical with n_buckets=1.
+        return cost.two_level_all_reduce_s(
+            grad_bytes, ici, dcn, n_buckets=1, wire=wire,
+            constants=constants,
+        )
+    n_seg = 1
+    if gr == "overlapped":
+        n_seg = knobs["overlap_stages"] or min(4, n_blocks)
+    n_buckets = max(
+        n_seg,
+        math.ceil(grad_bytes / (knobs["bucket_mb"] * 2 ** 20)),
+    )
+    return cost.two_level_all_reduce_s(
+        grad_bytes, ici, dcn, n_buckets=n_buckets, wire=wire,
+        constants=constants,
+    )
+
+
+def moe_closed_form_s(knobs: dict, elems: int, itemsize: int,
+                      ici: int, dcn: int,
+                      constants: Optional[Dict[str, float]] = None,
+                      ) -> float:
+    """Predicted dispatch+combine comm time for one ep candidate — the
+    §3c/§3c' exchange pair. Overlap reshapes the schedule, not the
+    asks, so it prices identically and wins only through the tie-break
+    (`space.preference`) when the extra structure is free."""
+    from distributed_model_parallel_tpu.observability import cost
+
+    if knobs["dispatch"] == "gspmd":
+        return 2 * cost.flat_all_to_all_s(
+            elems, itemsize, ici, dcn, constants=constants
+        )
+    wire = knobs["dcn_compression"]
+    return 2 * cost.hierarchical_all_to_all_s(
+        elems, itemsize, ici, dcn,
+        wire=None if wire == "none" else wire, constants=constants,
+    )
+
+
+def closed_form_step_s(family: str, knobs: dict, payload: dict,
+                       ici: int, dcn: int,
+                       constants: Optional[Dict[str, float]] = None,
+                       ) -> float:
+    if family in ("ddp", "fsdp", "sp_lm"):
+        return reducer_closed_form_s(
+            knobs, payload["grad_bytes"], ici, dcn,
+            n_blocks=payload.get("n_blocks", 4), constants=constants,
+        )
+    if family == "ep":
+        return moe_closed_form_s(
+            knobs, payload["elems"], payload["itemsize"], ici, dcn,
+            constants=constants,
+        )
+    return 0.0  # tp: both candidates are finalists; lowering decides
+
+
+def rank_candidates(family: str, cands: Sequence[dict], payload: dict,
+                    ici: int, dcn: int,
+                    constants: Optional[Dict[str, float]] = None,
+                    ) -> List[Tuple[float, dict]]:
+    """[(closed_form_s, knobs)] in the search's deterministic order:
+    score, then `space.preference`, then the canonical key."""
+    scored = [
+        (closed_form_step_s(family, k, payload, ici, dcn, constants),
+         tspace.preference(family, k), tspace.canonical_key(k), k)
+        for k in cands
+    ]
+    scored.sort(key=lambda t: t[:3])
+    return [(s, k) for s, _, _, k in scored]
+
+
+def closed_form_argmin(family: str, payload: dict, ici: int, dcn: int,
+                       constants: Optional[Dict[str, float]] = None,
+                       allow_cm: bool = True) -> Tuple[dict, float]:
+    """(argmin knobs, predicted seconds) under the closed forms alone —
+    the jax-free entry `experiments/scaling64.py` uses to put the
+    tuner's @64 answer next to its hand-derived rows."""
+    ranked = rank_candidates(
+        family, tspace.candidates(family, dcn, allow_cm=allow_cm),
+        payload, ici, dcn, constants,
+    )
+    score, knobs = ranked[0]
+    return knobs, score
+
+
+# ------------------------------------------------------ lowering tier
+
+
+def candidate_combo(cell: Cell, knobs: dict):
+    """Map one candidate onto the lint matrix's Combo vocabulary — the
+    shared lowering path (`lower_combo`) then prices and lints the SAME
+    program the engines would run."""
+    from distributed_model_parallel_tpu.analysis.lint import Combo
+
+    if cell.family in ("ddp", "fsdp", "sp_lm"):
+        return Combo(
+            cell.family, cell.size,
+            grad_reduction=knobs["grad_reduction"],
+            dcn=cell.dcn, model=cell.model,
+            dcn_compression=knobs["dcn_compression"],
+            collective_matmul=bool(knobs.get("collective_matmul")),
+            bucket_mb=knobs["bucket_mb"],
+            overlap_stages=knobs["overlap_stages"] or 0,
+        )
+    if cell.family == "ep":
+        return Combo(
+            "ep", cell.size, dcn=cell.dcn,
+            moe_dispatch=knobs["dispatch"],
+            moe_overlap=knobs["overlap"],
+            dcn_compression=knobs["dcn_compression"],
+        )
+    if cell.family == "tp":
+        return Combo(
+            "tp", cell.size,
+            collective_matmul=knobs["collective_matmul"],
+        )
+    raise ValueError(f"no combo mapping for family {cell.family!r}")
+
+
+def _lower_and_price(combo, devices, constants):
+    """(target, hlo, mesh_model, breakdown): ONE lowering feeds both
+    the pricing and (for the winner) the lint pass — the two can never
+    judge different programs."""
+    from distributed_model_parallel_tpu.analysis.collectives import (
+        MeshModel,
+        classify,
+    )
+    from distributed_model_parallel_tpu.analysis.hlo import parse_hlo
+    from distributed_model_parallel_tpu.analysis.lint import lower_combo
+    from distributed_model_parallel_tpu.observability.cost import (
+        fabrics_from_constants,
+        predict_collectives,
+    )
+
+    target, hlo, mesh = lower_combo(combo, devices)
+    mesh_model = MeshModel.from_mesh(mesh)
+    collectives = classify(parse_hlo(hlo), mesh_model)
+    breakdown = predict_collectives(
+        collectives, mesh_model, target.dcn_axis,
+        fabrics=fabrics_from_constants(constants)
+        if constants is not None else None,
+    )
+    return target, hlo, mesh_model, breakdown
+
+
+def search_cell(cell: Cell,
+                constants: Optional[Dict[str, float]] = None,
+                constants_source: str = "hand",
+                finalists: Optional[int] = DEFAULT_FINALISTS,
+                space_knobs: Optional[Sequence[dict]] = None,
+                allow_cm: bool = True,
+                devices=None,
+                emit=None) -> dict:
+    """Search one cell and return its validated plan dict.
+
+    `finalists=None` (or 0) lowers EVERY candidate — the brute-force
+    mode the argmin tests pin the pruned search against. `space_knobs`
+    overrides the family's full space (tests; scoped searches).
+    `constants` = a CONSTANTS-shaped dict (e.g.
+    `cost.load_calibration(path)`) with `constants_source` naming where
+    it came from."""
+    from distributed_model_parallel_tpu.analysis.rules import (
+        REGISTRY,
+        LintContext,
+        run_rules,
+    )
+    from distributed_model_parallel_tpu.observability.cost import (
+        CONSTANTS,
+    )
+    from distributed_model_parallel_tpu.tuning.plan import validate_plan
+
+    say = emit if emit is not None else (lambda s: None)
+    cands = list(
+        space_knobs if space_knobs is not None
+        else tspace.candidates(cell.family, cell.dcn,
+                               allow_cm=allow_cm)
+    )
+    if not cands:
+        raise ValueError(f"{cell.name}: empty candidate space")
+    ici = cell.size // cell.dcn
+    payload = cell_payload(cell)
+    ranked = rank_candidates(
+        cell.family, cands, payload, ici, cell.dcn, constants
+    )
+    k = len(ranked) if not finalists else min(finalists, len(ranked))
+    say(f"[tuning] {cell.name}: {len(ranked)} candidate(s), "
+        f"lowering the top {k}")
+
+    lowered = []
+    for closed_s, knobs in ranked[:k]:
+        combo = candidate_combo(cell, knobs)
+        target, hlo, mesh_model, breakdown = _lower_and_price(
+            combo, devices, constants
+        )
+        row = breakdown.as_row()
+        say(f"[tuning]   {combo.name}: closed-form "
+            f"{closed_s * 1e3:.4f} ms -> lowered "
+            f"{row['predicted_step_s'] * 1e3:.4f} ms/step")
+        lowered.append(
+            (row["predicted_step_s"],
+             tspace.preference(cell.family, knobs),
+             tspace.canonical_key(knobs),
+             knobs, combo, row, target, hlo, mesh_model)
+        )
+    lowered.sort(key=lambda t: t[:3])
+    (_, _, _, best_knobs, best_combo, best_row, target, hlo,
+     mesh_model) = lowered[0]
+
+    # Verify, don't trust: the full rule registry over the winner's
+    # OWN lowering (already in hand — no recompile).
+    ctx = LintContext.build(target, hlo, mesh_model)
+    findings = run_rules(ctx)
+    violations = [f for f in findings if not f.exempted]
+    errors = [f for f in violations if f.severity == "error"]
+    if errors:
+        raise PlanLintError(
+            f"{cell.name}: the searched argmin {best_combo.name} "
+            "violates collective contract(s) "
+            f"{', '.join(sorted({f.rule for f in errors}))} — plan "
+            "NOT emitted (tools/hlolint has the catalog)"
+        )
+    say(f"[tuning] {cell.name}: argmin {best_combo.name} "
+        f"({best_row['predicted_step_s'] * 1e3:.4f} ms/step), "
+        f"lint clean over {len(REGISTRY)} rules")
+
+    plan = make_plan(
+        cell, best_knobs, best_combo.name, best_row,
+        constants_source,
+        dict(CONSTANTS) if constants is None else dict(constants),
+        search={
+            "candidates": len(ranked),
+            "lowered": k,
+            "finalist_combos": [
+                t[4].name for t in sorted(lowered, key=lambda t: t[:3])
+            ],
+            "lint_violations": len(violations),
+            "lint_rules": len(REGISTRY),
+        },
+    )
+    return validate_plan(plan)
+
+
+__all__ = [
+    "DEFAULT_FINALISTS",
+    "PlanLintError",
+    "candidate_combo",
+    "cell_payload",
+    "closed_form_argmin",
+    "closed_form_step_s",
+    "moe_closed_form_s",
+    "rank_candidates",
+    "reducer_closed_form_s",
+    "search_cell",
+]

@@ -1,0 +1,229 @@
+"""The declarative search space: which knobs exist per engine family,
+which values each may take, and which CLI flag / engine parameter each
+one drives.
+
+Every `Knob` names its real surfaces (`cli_flag`, `engine_param`) so
+the conftest META-CHECK (`scan_knob_surface`) can fail collection when
+the space enumerates a knob that no engine or CLI actually accepts —
+a tuner that searches over a phantom knob would emit plans nobody can
+apply.
+
+`candidates(family, dcn)` expands the cross-product, filters the
+combinations the engines themselves refuse (wire compression without a
+'dcn' axis to cross, overlap chunking without the hierarchical
+dispatch, ...), canonicalizes inapplicable knobs to None so equivalent
+configurations dedupe, and returns the list in a deterministic order —
+the enumeration order IS part of the search's byte-stability contract.
+
+jax-free by module contract (imported at pytest collection time).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import itertools
+import json
+import os
+import re
+from typing import Dict, List, Optional, Tuple
+
+# Engine families the tuner knows how to search. They are the lint
+# matrix's combo vocabulary (`analysis/lint.py` builders), which is
+# what makes "price a candidate" a one-liner: every candidate maps to
+# a Combo the shared lowering path already understands.
+FAMILIES = ("ddp", "fsdp", "sp_lm", "ep", "tp")
+
+
+@dataclasses.dataclass(frozen=True)
+class Knob:
+    """One tunable dimension: its value grid and its REAL surfaces."""
+
+    name: str            # canonical key in a plan's "knobs" object
+    values: tuple        # the enumerable grid
+    cli_flag: str        # the training-CLI flag that sets it
+    engine_param: str    # the engine dataclass field it lands on
+
+
+# Bucket grid: 25 is the DDP Reducer's default cap; the sub-MB values
+# matter twice — small real models, and the tiny lint proxies whose
+# whole gradient fits one 0.1 MB bucket (a grid that never splits the
+# proxy would make bucket_mb a phantom knob on every searched cell).
+_BUCKET_GRID = (0.02, 0.1, 1.0, 25.0)
+
+_REDUCER_KNOBS = (
+    Knob("grad_reduction", ("monolithic", "bucketed", "overlapped"),
+         "--grad-reduction", "grad_reduction"),
+    Knob("bucket_mb", _BUCKET_GRID, "--bucket-mb", "bucket_mb"),
+    # 0 = the engines' auto default (min(4, blocks)).
+    Knob("overlap_stages", (0, 2), "--overlap-stages",
+         "overlap_stages"),
+    Knob("dcn_compression", ("none", "bf16", "int8"),
+         "--dcn-compression", "dcn_compression"),
+)
+
+_CM_KNOB = Knob("collective_matmul", (False, True),
+                "--collective-matmul", "collective_matmul")
+
+SPACES: Dict[str, Tuple[Knob, ...]] = {
+    "ddp": _REDUCER_KNOBS,
+    "fsdp": _REDUCER_KNOBS,
+    "sp_lm": _REDUCER_KNOBS + (_CM_KNOB,),
+    "ep": (
+        Knob("dispatch", ("gspmd", "hierarchical"), "--moe-dispatch",
+             "dispatch"),
+        Knob("overlap", (False, True), "--moe-overlap", "overlap"),
+        Knob("dcn_compression", ("none", "bf16", "int8"),
+             "--dcn-compression", "dcn_compression"),
+    ),
+    "tp": (_CM_KNOB,),
+}
+
+
+def canonical_key(knobs: dict) -> str:
+    """The deterministic identity of one candidate (sort/tie-break and
+    dedupe key)."""
+    return json.dumps(knobs, sort_keys=True)
+
+
+def _canonicalize(family: str, knobs: dict, dcn: int) -> Optional[dict]:
+    """Normalize one raw cross-product point: inapplicable knobs go to
+    None so equivalent configurations collapse; invalid combinations
+    (the ones the engines refuse at construction) return None."""
+    k = dict(knobs)
+    if family in ("ddp", "fsdp", "sp_lm"):
+        if k["dcn_compression"] != "none" and dcn < 2:
+            return None  # no 'dcn' hop to compress (engine guard)
+        if k["grad_reduction"] == "monolithic":
+            # Monolithic has no bucket surface (the compressed variant
+            # routes through ONE flat bucket, MONOLITHIC_BUCKET_MB —
+            # still not a knob) and no backward to segment.
+            k["bucket_mb"] = None
+            k["overlap_stages"] = None
+        elif k["grad_reduction"] == "bucketed":
+            k["overlap_stages"] = None
+    elif family == "ep":
+        if k["dispatch"] == "gspmd":
+            # The gspmd flat exchange has no explicit 'dcn' seam and no
+            # chunk ring to overlap; on a factored (dcn > 1) fabric it
+            # is exactly the lowering the hierarchical exchange
+            # replaced, so it leaves the space entirely there.
+            if dcn > 1 or k["overlap"] or k["dcn_compression"] != "none":
+                return None
+        elif k["dcn_compression"] != "none" and dcn < 2:
+            return None
+    return k
+
+
+def preference(family: str, knobs: dict) -> tuple:
+    """Deterministic tie-break among equal-cost candidates (the cost
+    engine prices what the program ASKS the network for; two configs
+    with identical asks differ only in schedule). Lower sorts first:
+    prefer the more-overlapped config (overlap changes dependency
+    structure at zero asked-bytes cost — the hlolint dependency pins
+    prove the overlap is real), then the larger bucket (fewer
+    launches), then the LESS exotic wire (a codec the bytes don't pay
+    for is free complexity)."""
+    if family in ("ddp", "fsdp", "sp_lm"):
+        return (
+            {"overlapped": 0, "bucketed": 1, "monolithic": 2}[
+                knobs["grad_reduction"]],
+            -(knobs["bucket_mb"] or float("inf")),
+            ("none", "bf16", "int8").index(knobs["dcn_compression"]),
+            knobs["overlap_stages"] or 0,
+            0 if knobs.get("collective_matmul") else 1,
+        )
+    if family == "ep":
+        return (
+            0 if knobs["dispatch"] == "hierarchical" else 1,
+            0 if knobs["overlap"] else 1,
+            ("none", "bf16", "int8").index(knobs["dcn_compression"]),
+        )
+    # tp: prefer the ring decomposition on a tie (latency hiding).
+    return (0 if knobs["collective_matmul"] else 1,)
+
+
+def candidates(family: str, dcn: int = 1,
+               allow_cm: bool = True) -> List[dict]:
+    """The deduped, deterministically ordered candidate list for one
+    engine family on a mesh with `dcn` cross-slice factor. `allow_cm`
+    drops the collective_matmul=True half when the run has no ring axis
+    (lm CLI with --seq-shards 1)."""
+    if family not in SPACES:
+        raise ValueError(
+            f"no search space for engine family {family!r} "
+            f"(tunable families: {', '.join(sorted(SPACES))})"
+        )
+    knob_list = SPACES[family]
+    out: Dict[str, dict] = {}
+    for values in itertools.product(*(k.values for k in knob_list)):
+        raw = {k.name: v for k, v in zip(knob_list, values)}
+        if not allow_cm and raw.get("collective_matmul"):
+            continue
+        k = _canonicalize(family, raw, dcn)
+        if k is not None:
+            out.setdefault(canonical_key(k), k)
+    return [out[key] for key in sorted(out)]
+
+
+# ------------------------------------------------- the knob META-CHECK
+
+
+def _package_root() -> str:
+    return os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _read_sources(subdir: str) -> str:
+    root = os.path.join(_package_root(), subdir)
+    chunks = []
+    for name in sorted(os.listdir(root)):
+        if name.endswith(".py"):
+            with open(os.path.join(root, name)) as f:
+                chunks.append(f.read())
+    return "\n".join(chunks)
+
+
+def scan_knob_surface() -> Dict[str, List[str]]:
+    """Literal source scan backing the conftest META-CHECK: every knob
+    the space enumerates must exist as (a) a CLI flag literal somewhere
+    under `cli/` and (b) an engine dataclass field (annotated
+    attribute) somewhere under `parallel/`. Returns
+    {knob_name: [what's missing, ...]} — empty means the space and the
+    real surfaces agree."""
+    cli_src = _read_sources("cli")
+    engine_src = _read_sources("parallel")
+    strays: Dict[str, List[str]] = {}
+    seen = set()
+    for family, knob_list in sorted(SPACES.items()):
+        for knob in knob_list:
+            if (knob.name, knob.cli_flag, knob.engine_param) in seen:
+                continue
+            seen.add((knob.name, knob.cli_flag, knob.engine_param))
+            missing = []
+            if f'"{knob.cli_flag}"' not in cli_src:
+                missing.append(
+                    f"CLI flag {knob.cli_flag} not found under cli/"
+                )
+            if not re.search(
+                rf"^\s*{re.escape(knob.engine_param)}\s*:",
+                engine_src, re.MULTILINE,
+            ):
+                missing.append(
+                    f"engine field {knob.engine_param!r} not found "
+                    "under parallel/"
+                )
+            if missing:
+                strays.setdefault(
+                    f"{family}.{knob.name}", []
+                ).extend(missing)
+    return strays
+
+
+__all__ = [
+    "FAMILIES",
+    "Knob",
+    "SPACES",
+    "candidates",
+    "canonical_key",
+    "preference",
+    "scan_knob_surface",
+]

@@ -436,6 +436,59 @@ def main():
             ),
         )
 
+    # ---- 3e. tuner argmin rows (tuning/search.py closed forms) -------
+    # The auto-tuner's answer for this @64 2x32 cell, next to the
+    # hand-picked §3a-§3d rows: enumerate each family's knob space and
+    # score with the SAME closed forms the rows above assert against.
+    # The hand configurations are points IN the searched space, so the
+    # argmin can never predict WORSE than them — asserted, like the
+    # cost-engine agreement tripwire.
+    from distributed_model_parallel_tpu.tuning.search import (
+        closed_form_argmin,
+    )
+
+    grad_knobs, grad_argmin_s = closed_form_argmin(
+        "ddp",
+        {"grad_bytes": opt_ar_bytes, "n_blocks": 16},
+        ici, DCN_SLICES,
+    )
+    print(f"tuner argmin (grad reduction @{DCN_SLICES}x{ici}): "
+          f"{json.dumps(grad_knobs, sort_keys=True)} -> "
+          f"{grad_argmin_s*1e3:.2f} ms (hand §3b bucketed row: "
+          f"{comm_two_level_s*1e3:.2f} ms)")
+    assert grad_argmin_s <= comm_two_level_s * (1 + 1e-9), (
+        f"tuner argmin {grad_argmin_s:.6e}s predicts WORSE than the "
+        f"hand §3b configuration {comm_two_level_s:.6e}s — the hand "
+        "config is in the search space, so the search is broken"
+    )
+    moe_knobs, moe_argmin_s = closed_form_argmin(
+        "ep",
+        {"elems": moe_x_elems, "itemsize": 2},
+        ici, DCN_SLICES,
+    )
+    moe_hand_pair_s = 2 * a2a_two_level_s  # §3c dispatch+combine
+    print(f"tuner argmin (MoE dispatch @{DCN_SLICES}x{ici}): "
+          f"{json.dumps(moe_knobs, sort_keys=True)} -> "
+          f"{moe_argmin_s*1e3:.2f} ms/exchange pair (hand §3c "
+          f"hierarchical pair: {moe_hand_pair_s*1e3:.2f} ms)")
+    assert moe_argmin_s <= moe_hand_pair_s * (1 + 1e-9), (
+        f"tuner argmin {moe_argmin_s:.6e}s predicts WORSE than the "
+        f"hand §3c configuration {moe_hand_pair_s:.6e}s — the hand "
+        "config is in the search space, so the search is broken"
+    )
+    tuned_rows = {
+        "grad_reduction": {
+            "knobs": grad_knobs,
+            "predicted_s": round(grad_argmin_s, 6),
+            "hand_two_level_s": round(comm_two_level_s, 6),
+        },
+        "moe_dispatch": {
+            "knobs": moe_knobs,
+            "predicted_exchange_pair_s": round(moe_argmin_s, 6),
+            "hand_exchange_pair_s": round(moe_hand_pair_s, 6),
+        },
+    }
+
     out = {
         "n_devices": N,
         "per_chip_batch": PER_CHIP_BATCH,
@@ -490,6 +543,9 @@ def main():
         # compressed 'dcn' wire rows (PR 11, ops/wire_codec.py)
         "grad_wire_rows": wire_rows,
         "moe_wire_rows": moe_wire_rows,
+        # tuner argmin rows (tuning/search.py closed forms) — asserted
+        # never worse than the hand §3b/§3c configurations above
+        "tuned_rows": tuned_rows,
     }
     path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                         "scaling64.json")

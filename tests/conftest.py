@@ -88,6 +88,27 @@ def pytest_collection_modifyitems(config, items):
             )
         )
 
+    # Tuner-knob META-CHECK: every knob the auto-tuner's search space
+    # enumerates (tuning/space.py SPACES) must correspond to a real
+    # CLI flag under cli/ AND a real engine dataclass field under
+    # parallel/ — a tuner searching over a phantom knob would emit
+    # plans nobody can apply. Literal source scan, jax-free by the
+    # space module's contract, runs on every collection.
+    from distributed_model_parallel_tpu.tuning.space import (
+        scan_knob_surface,
+    )
+
+    stray_knobs = scan_knob_surface()
+    if stray_knobs:
+        raise pytest.UsageError(
+            "every tuner knob must map to a real engine/CLI "
+            "parameter (tuning/space.py SPACES): "
+            + "; ".join(
+                f"{knob}: {', '.join(missing)}"
+                for knob, missing in sorted(stray_knobs.items())
+            )
+        )
+
     # slow-twin meta-check: group collected items by test function; a
     # function whose EVERY case is slow must document its tier-1 twin.
     # Only meaningful when whole files/dirs were collected: a direct

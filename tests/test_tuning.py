@@ -1,0 +1,548 @@
+"""tuning/ — the cost-engine auto-tuner (INTERNALS.md §15).
+
+Covers the ISSUE-14 contract: plan schema round-trip with strict
+unknown-field/version rejection, search determinism (two runs,
+byte-equal plans), argmin pinned equal to brute-force enumeration on
+a small space, the calibration-vs-hand constants divergence case, the
+CLI guard surface (`--auto-tune` vs explicit knob flags; mesh-mismatch
+plans refused with the field named), and plangate's
+regression/missing-row/tolerance semantics in the costgate style
+(pure `gate_check`, nothing compiled)."""
+
+import json
+
+import pytest
+
+from distributed_model_parallel_tpu.observability.cost import CONSTANTS
+from distributed_model_parallel_tpu.tuning import plan as tplan
+from distributed_model_parallel_tpu.tuning import plangate, space
+from distributed_model_parallel_tpu.tuning.plan import Cell
+
+# ----------------------------------------------------------- fixtures
+
+
+def _mk_plan(cell=None, knobs=None, predicted_s=1e-4):
+    cell = cell or Cell("ddp", 8, 2, "tinycnn")
+    knobs = knobs or {
+        "grad_reduction": "bucketed", "bucket_mb": 25.0,
+        "overlap_stages": None, "dcn_compression": "bf16",
+    }
+    return tplan.make_plan(
+        cell, knobs, "ddp/S8/dcn2/bucketed/wire-bf16/b25/tinycnn",
+        {"predicted_step_s": predicted_s, "alpha_s": predicted_s,
+         "beta_s": 0.0, "n_collectives": 4},
+        "hand", dict(CONSTANTS),
+        search={"candidates": 39, "lowered": 4,
+                "lint_violations": 0, "lint_rules": 15},
+    )
+
+
+# -------------------------------------------------------- plan schema
+
+
+def test_plan_schema_roundtrip(tmp_path):
+    p = _mk_plan()
+    path = str(tmp_path / "plan.json")
+    tplan.save_plan(path, p)
+    assert tplan.load_plan(path) == p
+    # Canonical bytes: the file IS dumps_plan's output, and re-dumping
+    # the loaded object reproduces it (sorted keys, fixed indent).
+    with open(path) as f:
+        assert f.read() == tplan.dumps_plan(p)
+
+
+def test_plan_unknown_field_and_version_rejected(tmp_path):
+    good = _mk_plan()
+    with pytest.raises(ValueError, match="schema"):
+        tplan.validate_plan({**good, "schema": "dmpt.plan.v2"})
+    with pytest.raises(ValueError, match="unknown field.*surprise"):
+        tplan.validate_plan({**good, "surprise": 1})
+    with pytest.raises(ValueError, match="missing field"):
+        tplan.validate_plan(
+            {k: v for k, v in good.items() if k != "knobs"}
+        )
+    bad_mesh = json.loads(json.dumps(good))
+    bad_mesh["cell"]["mesh"]["dcn"] = 0
+    with pytest.raises(ValueError, match="cell.mesh.dcn"):
+        tplan.validate_plan(bad_mesh)
+    bad_cell = json.loads(json.dumps(good))
+    bad_cell["cell"].pop("model")
+    with pytest.raises(ValueError, match="cell must carry"):
+        tplan.validate_plan(bad_cell)
+    # Corrupt files surface as ValueError with the path named.
+    path = tmp_path / "broken.json"
+    path.write_text("{not json")
+    with pytest.raises(ValueError, match="not JSON"):
+        tplan.load_plan(str(path))
+    # Knob-level strictness: unknown knob keys, values outside the
+    # space's type, and non-tunable families all fail NAMING the
+    # offender (not as an anonymous TypeError in engine construction).
+    with pytest.raises(ValueError, match="knobs.*warp_factor"):
+        tplan.validate_plan({
+            **good, "knobs": {**good["knobs"], "warp_factor": 9},
+        })
+    with pytest.raises(ValueError, match=r"knobs\.bucket_mb.*'25'"):
+        tplan.validate_plan({
+            **good, "knobs": {**good["knobs"], "bucket_mb": "25"},
+        })
+    with pytest.raises(ValueError, match=r"knobs\.overlap_stages"):
+        tplan.validate_plan({
+            **good, "knobs": {**good["knobs"], "overlap_stages": True},
+        })
+    bad_family = json.loads(json.dumps(good))
+    bad_family["cell"]["family"] = "pipeline"
+    with pytest.raises(ValueError, match="not a tunable family"):
+        tplan.validate_plan(bad_family)
+    # A truncated/non-object predicted is a NAMED ValueError, never a
+    # raw TypeError (load_plan's callers catch ValueError only).
+    with pytest.raises(ValueError, match="predicted must be"):
+        tplan.validate_plan({**good, "predicted": None})
+
+
+# -------------------------------------------------------- search space
+
+
+def test_candidate_space_canonicalization():
+    # Inapplicable knobs collapse to None so equivalent configurations
+    # dedupe; invalid combinations never appear.
+    dcn1 = space.candidates("ddp", 1)
+    assert all(k["dcn_compression"] == "none" for k in dcn1)
+    monos = [k for k in dcn1 if k["grad_reduction"] == "monolithic"]
+    assert monos == [{
+        "grad_reduction": "monolithic", "bucket_mb": None,
+        "overlap_stages": None, "dcn_compression": "none",
+    }]
+    dcn2 = space.candidates("ddp", 2)
+    assert len(dcn2) > len(dcn1)
+    # Deterministic enumeration: the order IS the tie-break substrate.
+    assert dcn2 == space.candidates("ddp", 2)
+    # ep: gspmd survives only on the single fabric (the flat exchange
+    # over a factored mesh is what the hierarchical path replaced).
+    assert any(
+        k["dispatch"] == "gspmd" for k in space.candidates("ep", 1)
+    )
+    assert all(
+        k["dispatch"] == "hierarchical"
+        for k in space.candidates("ep", 2)
+    )
+    # allow_cm=False drops the ring half of the sp_lm space.
+    assert all(
+        not k["collective_matmul"]
+        for k in space.candidates("sp_lm", 2, allow_cm=False)
+    )
+    with pytest.raises(ValueError, match="pipeline"):
+        space.candidates("pipeline")
+
+
+def test_knob_surface_scan_is_clean_and_catches_strays(monkeypatch):
+    assert space.scan_knob_surface() == {}
+    # A phantom knob (no CLI flag, no engine field) is named.
+    monkeypatch.setitem(
+        space.SPACES, "ddp",
+        space.SPACES["ddp"] + (space.Knob(
+            "warp_factor", (1, 9), "--warp-factor", "warp_factor"
+        ),),
+    )
+    strays = space.scan_knob_surface()
+    assert "ddp.warp_factor" in strays
+    assert len(strays["ddp.warp_factor"]) == 2  # CLI and engine
+
+
+# ----------------------------------------- search (lowering, argmin)
+
+# The small, fully-canonical space the lowering tests share: distinct
+# cost structures (fused-over-dcn vs bucket rings vs compressed wire)
+# so the argmin is meaningful, small enough that brute force is cheap.
+_SMALL_SPACE = (
+    {"grad_reduction": "monolithic", "bucket_mb": None,
+     "overlap_stages": None, "dcn_compression": "none"},
+    {"grad_reduction": "bucketed", "bucket_mb": 25.0,
+     "overlap_stages": None, "dcn_compression": "bf16"},
+    {"grad_reduction": "bucketed", "bucket_mb": 25.0,
+     "overlap_stages": None, "dcn_compression": "int8"},
+)
+_CELL = Cell("ddp", 4, 2, "mlp")
+
+
+def test_search_determinism_bruteforce_and_lint(devices):
+    """Two pruned searches are byte-identical; the pruned argmin equals
+    brute-force enumeration (finalists=None lowers EVERY candidate);
+    the winner passed the full hlolint registry."""
+    from distributed_model_parallel_tpu.tuning.search import search_cell
+
+    p1 = search_cell(_CELL, space_knobs=_SMALL_SPACE, finalists=2,
+                     devices=devices)
+    p2 = search_cell(_CELL, space_knobs=_SMALL_SPACE, finalists=2,
+                     devices=devices)
+    assert tplan.dumps_plan(p1) == tplan.dumps_plan(p2)
+    brute = search_cell(_CELL, space_knobs=_SMALL_SPACE,
+                        finalists=None, devices=devices)
+    assert brute["search"]["lowered"] == len(_SMALL_SPACE)
+    assert p1["knobs"] == brute["knobs"]
+    assert p1["combo"] == brute["combo"]
+    assert p1["predicted"] == brute["predicted"]
+    # Verified, not trusted: the argmin's own lowering linted clean
+    # over the FULL registry.
+    assert p1["search"]["lint_violations"] == 0
+    from distributed_model_parallel_tpu.analysis.rules import REGISTRY
+
+    assert p1["search"]["lint_rules"] == len(REGISTRY)
+    assert p1["constants"] == {
+        "source": "hand",
+        "values": {k: CONSTANTS[k] for k in sorted(CONSTANTS)},
+    }
+
+
+def test_search_calibration_vs_hand_divergence(devices):
+    """Measured physics changes the answer: under the hand constants
+    the bf16 wire wins the compressed pair (int8's scale sidecars cost
+    extra dcn hops for a negligible byte saving on the tiny proxy);
+    under a fitted-constants stand-in where dcn latency is free and
+    dcn bandwidth is scarce, the byte term dominates and int8 wins."""
+    from distributed_model_parallel_tpu.tuning.search import search_cell
+
+    pair = _SMALL_SPACE[1:]  # bf16 vs int8, same bucket structure
+    hand = search_cell(_CELL, space_knobs=pair, finalists=None,
+                       devices=devices)
+    assert hand["knobs"]["dcn_compression"] == "bf16"
+    fitted = dict(CONSTANTS)
+    fitted["alpha_dcn_hop_s"] = 1e-12   # sidecar hops now free
+    fitted["bw_dcn_effective_bytes_per_s"] = 1e6  # bytes now scarce
+    cal = search_cell(
+        _CELL, space_knobs=pair, finalists=None, devices=devices,
+        constants=fitted, constants_source="calibration:test",
+    )
+    assert cal["knobs"]["dcn_compression"] == "int8"
+    assert cal["constants"]["source"] == "calibration:test"
+    assert cal["constants"]["values"] == fitted
+
+
+def test_closed_form_argmin_never_worse_than_hand_rows():
+    """The jax-free closed-form entry scaling64 uses: the hand-picked
+    configurations are points in the space, so the argmin's predicted
+    time is <= theirs by construction (the scaling64 assertion,
+    exercised here without importing experiments/)."""
+    from distributed_model_parallel_tpu.observability import cost
+    from distributed_model_parallel_tpu.tuning.search import (
+        closed_form_argmin,
+    )
+
+    grad_bytes = 102_000_000  # ~ResNet-50 f32 grads
+    ici, dcn = 32, 2
+    knobs, argmin_s = closed_form_argmin(
+        "ddp", {"grad_bytes": grad_bytes, "n_blocks": 16}, ici, dcn
+    )
+    hand_s = cost.two_level_all_reduce_s(
+        grad_bytes, ici, dcn,
+        n_buckets=-(-grad_bytes // (25 * 2 ** 20)),
+    )
+    assert argmin_s <= hand_s * (1 + 1e-9)
+    # At 102 MB over a slow 'dcn' hop the wire MUST compress (the
+    # compressed cross-slice leg is 2-4x cheaper; which reduction
+    # carries it is the argmin's business — compressed-monolithic's
+    # single flat bucket legitimately minimizes alpha here).
+    assert knobs["dcn_compression"] != "none"
+    moe_knobs, moe_s = closed_form_argmin(
+        "ep", {"elems": 10_485_760, "itemsize": 2}, ici, dcn
+    )
+    hand_moe_s = 2 * cost.hierarchical_all_to_all_s(
+        10_485_760, 2, ici, dcn
+    )
+    assert moe_s <= hand_moe_s * (1 + 1e-9)
+    assert moe_knobs["dispatch"] == "hierarchical"
+
+
+# -------------------------------------------------------- CLI guards
+
+
+def test_auto_tune_explicit_flag_guards():
+    """--auto-tune owns the knobs: any explicit knob flag alongside it
+    fails fast with the flag named, on both CLIs; so do the engines
+    with nothing to tune."""
+    from distributed_model_parallel_tpu.cli import data_parallel, lm
+
+    with pytest.raises(SystemExit, match="--grad-reduction"):
+        data_parallel.main([
+            "--auto-tune", "search", "--engine", "ddp",
+            "--grad-reduction", "bucketed", "--model", "tinycnn",
+            "-type", "Synthetic",
+        ])
+    with pytest.raises(SystemExit, match="--bucket-mb"):
+        data_parallel.main([
+            "--auto-tune", "search", "--engine", "fsdp",
+            "--bucket-mb", "4", "--model", "tinycnn",
+            "-type", "Synthetic",
+        ])
+    with pytest.raises(SystemExit, match="no tunable knobs"):
+        data_parallel.main([
+            "--auto-tune", "search", "--model", "tinycnn",
+            "-type", "Synthetic",
+        ])
+    with pytest.raises(SystemExit, match="--collective-matmul"):
+        lm.main(["--auto-tune", "search", "--collective-matmul",
+                 "--seq-shards", "2"])
+    with pytest.raises(SystemExit, match="--moe-dispatch"):
+        lm.main(["--auto-tune", "search", "--moe-experts", "8",
+                 "--moe-dispatch", "hierarchical"])
+    with pytest.raises(SystemExit, match="pipeline"):
+        lm.main(["--auto-tune", "search", "--pipeline-stages", "2"])
+    with pytest.raises(SystemExit, match="--expert-shards"):
+        lm.main(["--auto-tune", "search", "--moe-experts", "8",
+                 "--expert-shards", "2"])
+    # --auto-tune-calibration is a SEARCH-mode knob.
+    with pytest.raises(SystemExit, match="calibration"):
+        data_parallel.main([
+            "--auto-tune", "plan.json", "--auto-tune-calibration",
+            "cal.json", "--engine", "ddp", "--model", "tinycnn",
+            "-type", "Synthetic",
+        ])
+
+
+def test_auto_tune_plan_mesh_mismatch_named(tmp_path):
+    """A committed plan whose cell disagrees with the run is refused
+    with the exact plan field named — never silently half-applied."""
+    from distributed_model_parallel_tpu.cli import data_parallel
+
+    path = str(tmp_path / "plan.json")
+    tplan.save_plan(path, _mk_plan())  # ddp / S8 / dcn2 / tinycnn
+    with pytest.raises(SystemExit, match=r"cell\.mesh\.dcn"):
+        data_parallel.main([
+            "--auto-tune", path, "--engine", "ddp",
+            "--model", "tinycnn", "-type", "Synthetic",
+        ])
+    with pytest.raises(SystemExit, match=r"cell\.family"):
+        data_parallel.main([
+            "--auto-tune", path, "--engine", "fsdp",
+            "--dcn-slices", "2", "--model", "tinycnn",
+            "-type", "Synthetic",
+        ])
+    with pytest.raises(SystemExit, match=r"cell\.model"):
+        data_parallel.main([
+            "--auto-tune", path, "--engine", "ddp",
+            "--dcn-slices", "2", "--model", "bert_tiny",
+            "-type", "SyntheticText",
+        ])
+
+
+def test_auto_tune_plan_file_applies_knobs(tmp_path):
+    """A MATCHING plan file applies its knobs onto the parsed args
+    (no search, no lowering) — the committed-plan fast path."""
+    from distributed_model_parallel_tpu.cli import data_parallel
+    from distributed_model_parallel_tpu.tuning.apply import (
+        auto_tune_data_parallel,
+    )
+
+    path = str(tmp_path / "plan.json")
+    tplan.save_plan(path, _mk_plan())
+    args = data_parallel.build_parser().parse_args([
+        "--auto-tune", path, "--engine", "ddp", "--dcn-slices", "2",
+        "--model", "tinycnn", "-type", "Synthetic",
+    ])
+    auto_tune_data_parallel(args)
+    assert args.grad_reduction == "bucketed"
+    assert args.bucket_mb == 25.0
+    assert args.overlap_stages is None
+    assert args.dcn_compression == "bf16"
+
+
+def test_lm_auto_tune_search_applies_and_lints_clean(
+    tmp_path, monkeypatch
+):
+    """The acceptance pin on `cli/lm.py --auto-tune search`: the
+    search runs for the sp_lm proxy cell, the argmin's RE-LOWERED
+    configuration lints CLEAN under the full hlolint registry (the
+    search refuses to emit otherwise), the knobs land on args in the
+    shapes the existing guards expect, and the plan round-trips
+    through --auto-tune-out. Finalists clamped to 1 here for tier-1
+    budget; the slow lm e2e drives the full default search."""
+    import functools
+
+    from distributed_model_parallel_tpu.cli import lm
+    from distributed_model_parallel_tpu.tuning import search as tsearch
+    from distributed_model_parallel_tpu.tuning.apply import auto_tune_lm
+
+    # Capture the original BEFORE patching: the partial pins
+    # finalists=1 on the real search (apply calls it without the
+    # kwarg, inheriting the default 4 — too heavy for tier-1; argmin
+    # quality is the brute-force test's pin, this test pins the
+    # search->verify->apply seam).
+    monkeypatch.setattr(
+        tsearch, "search_cell",
+        functools.partial(tsearch.search_cell, finalists=1),
+    )
+    out = str(tmp_path / "plan.json")
+    args = lm.build_parser().parse_args([
+        "--auto-tune", "search", "--auto-tune-out", out,
+    ])
+    plan = auto_tune_lm(args)
+    assert plan["search"]["lint_violations"] == 0
+    from distributed_model_parallel_tpu.analysis.rules import REGISTRY
+
+    assert plan["search"]["lint_rules"] == len(REGISTRY)
+    # Knobs landed in CLI shape: the guards downstream accept them.
+    assert args.grad_reduction == plan["knobs"]["grad_reduction"]
+    assert args.dcn_compression == plan["knobs"]["dcn_compression"]
+    from distributed_model_parallel_tpu.cli.common import (
+        check_grad_reduction_args,
+    )
+
+    check_grad_reduction_args(args)  # must not raise
+    # The artifact round-trips.
+    assert tplan.load_plan(out)["knobs"] == plan["knobs"]
+
+
+@pytest.mark.slow
+def test_lm_cli_auto_tune_search_e2e(tmp_path, monkeypatch):
+    """Full `lm.py --auto-tune search` end to end: search (default
+    finalists), apply, train one tiny epoch. `slow` (tier-1 budget);
+    tier-1 twin: test_lm_auto_tune_search_applies_and_lints_clean
+    drives the same search+apply seam without the training epoch."""
+    monkeypatch.chdir(tmp_path)
+    from distributed_model_parallel_tpu.cli import lm
+
+    out = lm.main([
+        "--auto-tune", "search",
+        "--auto-tune-out", str(tmp_path / "plan.json"),
+        "--dim", "16", "--layers", "2", "--heads", "2",
+        "--seq-len", "32", "-b", "8", "--epochs", "1",
+        "--steps-per-epoch", "2", "--corpus-tokens", "2048",
+    ])
+    assert len(out["history"]) == 1
+    assert tplan.load_plan(str(tmp_path / "plan.json"))
+
+
+# ------------------------------------------------------ plangate gate
+
+
+def _artifact(rows=None, tolerance=0.05, constants=None):
+    return {
+        "schema": plangate.PLANS_SCHEMA,
+        "constants": dict(constants or CONSTANTS),
+        "tolerance": tolerance,
+        "cells": dict(rows or {}),
+    }
+
+
+_ROW = {
+    "knobs": {"grad_reduction": "bucketed", "bucket_mb": 25.0,
+              "overlap_stages": None, "dcn_compression": "bf16"},
+    "combo": "ddp/S8/dcn2/bucketed/wire-bf16/b25/tinycnn",
+    "predicted_step_s": 2e-3,
+}
+
+
+def test_plangate_gate_check_semantics():
+    """The costgate-style pure gate: clean pass, knob drift named,
+    predicted-time drift past tolerance (either direction), missing
+    row, constants drift, and the pregate name-check."""
+    art = _artifact({"ddp/S8/dcn2/tinycnn": _ROW})
+    ok = {"ddp/S8/dcn2/tinycnn": dict(_ROW)}
+    assert plangate.gate_check(art, ok) == []
+
+    # Knob drift: the drifted knob is named with old -> new.
+    drifted = {"ddp/S8/dcn2/tinycnn": {
+        **_ROW,
+        "knobs": {**_ROW["knobs"], "dcn_compression": "int8"},
+    }}
+    fails = plangate.gate_check(art, drifted)
+    assert len(fails) == 1
+    assert "argmin drifted" in fails[0]
+    assert "dcn_compression 'bf16' -> 'int8'" in fails[0]
+
+    # Predicted drift past tolerance, both directions; within passes.
+    for factor, should_fail in ((1.5, True), (0.5, True),
+                                (1.04, False), (0.96, False)):
+        res = {"ddp/S8/dcn2/tinycnn": {
+            **_ROW, "predicted_step_s": _ROW["predicted_step_s"]
+            * factor,
+        }}
+        fails = plangate.gate_check(art, res)
+        assert bool(fails) == should_fail, (factor, fails)
+        if should_fail:
+            assert "drifted" in fails[0]
+
+    # Missing row (searched but uncommitted) and name-check coverage.
+    fails = plangate.gate_check(art, {"ep/S4/dcn2": dict(_ROW)})
+    assert len(fails) == 1 and "no committed plan" in fails[0]
+    fails = plangate.gate_check(
+        art, ok, require_rows_for=["ddp/S8/dcn2/tinycnn", "tp/S4"]
+    )
+    assert len(fails) == 1 and fails[0].startswith("tp/S4:")
+
+    # Constants drift: comparisons across physics are refused.
+    stale = _artifact({"ddp/S8/dcn2/tinycnn": _ROW},
+                      constants={**CONSTANTS, "alpha_hop_s": 9e-9})
+    fails = plangate.gate_check(stale, ok)
+    assert any("constants drift" in f for f in fails)
+
+    # Explicit tolerance override beats the artifact's.
+    res = {"ddp/S8/dcn2/tinycnn": {
+        **_ROW, "predicted_step_s": _ROW["predicted_step_s"] * 1.04,
+    }}
+    assert plangate.gate_check(art, res, tolerance=0.01)
+
+    # Orphaned artifact rows (a committed cell the grid no longer
+    # searches) are flagged when the caller passes the current grid.
+    orphan = _artifact({"ddp/S8/dcn2/tinycnn": _ROW,
+                        "ep/S16/dcn2": _ROW})
+    fails = plangate.gate_check(
+        orphan, ok, known_cells=["ddp/S8/dcn2/tinycnn"]
+    )
+    assert len(fails) == 1 and "no longer in the grid" in fails[0]
+    assert fails[0].startswith("ep/S16/dcn2:")
+
+
+def test_bench_plan_family_mismatch_refused(tmp_path):
+    """Satellite guard: `bench.py --plan` refuses a plan whose engine
+    family does not match the sweep — a cross-family plan would
+    default-fill knobs and commit a mislabeled 'tuned' row."""
+    import bench
+
+    path = str(tmp_path / "plan.json")
+    tplan.save_plan(path, _mk_plan())  # ddp family
+    with pytest.raises(SystemExit, match=r"cell\.family.*'ddp'"):
+        bench._bench_plan(path, ("ep",), "MoE")
+    knobs, combo = bench._bench_plan(
+        path, ("ddp", "fsdp", "sp_lm"), "reducer"
+    )
+    assert knobs["grad_reduction"] == "bucketed"
+    assert combo.startswith("ddp/")
+
+
+def test_plangate_grid_is_pinned():
+    """The committed grid keeps its acceptance shape: >= 8 cells, every
+    tunable family represented, pregate cells drawn from it."""
+    cells = plangate.grid()
+    names = [c.name for c in cells]
+    assert len(names) == len(set(names)) >= 8
+    assert {c.family for c in cells} == set(space.SPACES)
+    grid_names = set(names)
+    for cell in plangate.pregate_cells():
+        assert cell.name in grid_names
+
+
+def test_costgate_calibration_tolerance_gates(tmp_path):
+    """Satellite: `costgate --calibration-tolerance PCT` upgrades
+    drift past the threshold to the exit-4 path, BEFORE any lowering;
+    default stays report-only (covered by test_observability's
+    report-only case); the flag without --calibration is a usage
+    error."""
+    from distributed_model_parallel_tpu.observability import costgate
+
+    cal = tmp_path / "calibration.json"
+    cal.write_text(json.dumps({
+        "constants": {k: v * 1.5 for k, v in CONSTANTS.items()},
+    }))
+    rc = costgate.main([
+        "--calibration", str(cal), "--calibration-tolerance", "10",
+    ])
+    assert rc == costgate.EXIT_GATE_FAILED
+    # Within tolerance: the calibration check passes and the run
+    # proceeds to combo selection (empty --filter match exits 2 —
+    # proving we got PAST the calibration gate).
+    rc = costgate.main([
+        "--calibration", str(cal), "--calibration-tolerance", "60",
+        "--filter", "zzz-no-such-combo",
+    ])
+    assert rc == 2
+    assert costgate.main(["--calibration-tolerance", "10"]) == 2

@@ -22,6 +22,9 @@
 #     the tinycnn-sized hierarchical-MoE combo, so a broken
 #     ring/fabric/overlap/dispatch contract fails in seconds with the
 #     violated rule named (INTERNALS.md section 8b has the catalog),
+#   * costgate / obsreport / plangate PRE-GATES (exits 4/5/6): the
+#     static cost ledger, the golden run report, and the auto-tuner's
+#     committed plan grid, each failing with the combo/line/cell named,
 #   * 870 s budget with a hard kill 10 s later,
 #   * DOTS_PASSED=<n> printed from the progress dots as a
 #     tamper-resistant pass count (parsed from the tee'd log, not from
@@ -99,6 +102,29 @@ fi
 echo "[tier1] costgate pre-gate ok:" \
   "$(grep -ac '"partial": true' /tmp/_t1_costgate.log || true)" \
   "combo(s) priced within tolerance"
+
+# plangate pre-gate (the auto-tuner twin of the costgate pre-gate):
+# re-run the deterministic knob search for the tier-1 cell cut
+# (tinycnn DDP + the hierarchical-MoE cell) and compare argmin knobs +
+# predicted step time against the committed
+# experiments/tuned_plans.json, name-checking every grid cell — a
+# drifted argmin (the cost landscape moved under an engine change) or
+# a plan-less cell fails in seconds with the cell NAMED. Exit 6
+# distinguishes a plan drift from a report regression (5), a cost
+# regression (4), a contract violation (3) and a collection failure
+# (2).
+rm -f /tmp/_t1_plangate.log
+if ! timeout -k 5 420 bash tools/plangate --pregate \
+    > /tmp/_t1_plangate.log 2>&1; then
+  echo "[tier1] PLANGATE PRE-GATE FAILED — a tuned plan's argmin or" \
+    "predicted time drifted (tools/plangate, INTERNALS.md section 15):"
+  grep -aE "FAIL|plangate" /tmp/_t1_plangate.log | head -20
+  echo DOTS_PASSED=0
+  exit 6
+fi
+echo "[tier1] plangate pre-gate ok:" \
+  "$(grep -ac '"partial": true' /tmp/_t1_plangate.log || true)" \
+  "cell(s) re-searched within tolerance"
 
 # obsreport pre-gate (the measured twin of the costgate pre-gate):
 # render the canned golden trace + metrics + ledger through the
