@@ -1464,21 +1464,30 @@ def run_child_serving(max_devices: int, platform: str = "cpu") -> None:
     )
     from distributed_model_parallel_tpu.serving.engine import ServingEngine
 
+    from distributed_model_parallel_tpu.serving.scheduler import Request
+
     devices = jax.devices("cpu") if platform == "cpu" else jax.devices()
     num_slots, p_len, max_len, new_steps, n_prefills = 8, 16, 64, 32, 8
+    page_size = 8
     cfg = GPTConfig(
         vocab_size=128, dim=64, num_layers=2, num_heads=4, ffn_dim=128,
         max_position=max_len, dropout_rate=0.0,
     )
-    legs = [("replicated", 1, False)]
+    # (layout, axis size, collective_matmul, paged) — every contiguous
+    # leg has a paged twin so the table answers paged-vs-contiguous
+    # per leg (prefill and decode separately).
+    legs = [("replicated", 1, False, False),
+            ("replicated", 1, False, True)]
     for s in (2, 4):
         if s <= min(max_devices, len(devices)):
-            legs += [("tp", s, False), ("tp", s, True), ("sp", s, False)]
+            legs += [("tp", s, False, False), ("tp", s, True, False),
+                     ("tp", s, True, True), ("sp", s, False, False),
+                     ("sp", s, False, True)]
     rng = np.random.RandomState(0)
     prompt = rng.randint(1, cfg.vocab_size, size=p_len).astype(np.int32)
 
     rows = []
-    for layout, size, cm in legs:
+    for layout, size, cm, paged in legs:
         mesh = None
         if layout != "replicated":
             spec = MeshSpec(
@@ -1491,40 +1500,86 @@ def run_child_serving(max_devices: int, platform: str = "cpu") -> None:
         eng = ServingEngine(
             cfg, mesh, layout=layout, num_slots=num_slots,
             max_len=max_len, prefill_len=p_len, collective_matmul=cm,
+            page_size=page_size if paged else None,
         )
         params = eng.init_params(jax.random.PRNGKey(0))
         ids, length = eng.pad_prompt(prompt)
         tokens = jnp.zeros((num_slots,), jnp.int32)
         active = jnp.ones((num_slots,), jnp.bool_)
+        host = eng.new_host() if paged else None
+
+        def do_prefill(cache, slot):
+            if paged:
+                host.ensure_pages(slot, p_len)
+                return eng.prefill(
+                    params, cache, host.device_row(slot), ids,
+                    length,
+                )
+            return eng.prefill(
+                params, cache, ids, length, jnp.int32(slot)
+            )
+
+        # Paged decode-leg bookkeeping is prepared OUTSIDE the timed
+        # window (pages pre-allocated for every step, block table +
+        # per-step positions uploaded once — `prep_decode`, called
+        # AFTER the admission accounting snapshot below so the
+        # at-prefill number stays honest): the timed region must be
+        # the compiled step for BOTH cache layouts, or the
+        # paged-vs-contiguous and delta_pct columns would charge host
+        # Python to the paged device step.
+        decode_args = {}
+
+        def prep_decode():
+            if not paged:
+                return
+            for slot in range(num_slots):
+                # warmup + timed steps: one new position per call.
+                host.ensure_pages(slot, p_len + new_steps + 2)
+            decode_args["bt"] = host.device_table()
+            decode_args["positions"] = [
+                jnp.asarray(
+                    np.full((num_slots,), p_len + i, np.int32)
+                )
+                for i in range(new_steps + 2)
+            ]
+
+        def do_decode(cache, step):
+            if paged:
+                return eng.decode_step(
+                    params, cache, decode_args["bt"],
+                    decode_args["positions"][step], tokens, active,
+                )
+            return eng.decode_step(params, cache, tokens, active)
 
         # --- prefill leg: fill every slot once (slot 0 is the warmup
         # compile), then re-ingest for the timed calls.
         cache = eng.init_cache()
-        cache, nl = eng.prefill(params, cache, ids, length, jnp.int32(0))
+        cache, nl = do_prefill(cache, 0)
         jax.block_until_ready(nl)
         for slot in range(1, num_slots):
-            cache, nl = eng.prefill(
-                params, cache, ids, length, jnp.int32(slot)
-            )
+            cache, nl = do_prefill(cache, slot)
         jax.block_until_ready(nl)
         prefill_ms = []
         for i in range(n_prefills):
             t0 = time.perf_counter()
-            cache, nl = eng.prefill(
-                params, cache, ids, length, jnp.int32(i % num_slots)
-            )
+            cache, nl = do_prefill(cache, i % num_slots)
             jax.block_until_ready(nl)
             prefill_ms.append((time.perf_counter() - t0) * 1e3)
+        # Admission-time accounting snapshot: every slot holds a
+        # p_len-token prompt, so paged allocation pins
+        # ceil(p_len/page) pages per slot vs the contiguous layout's
+        # max_len stripe (the decode leg below then grows it a token
+        # per step — both numbers land in the row).
+        prefill_kv_bytes = host.pool.kv_cache_bytes if paged else None
 
         # --- decode leg: every slot active at the prompt position.
-        cache, logits = eng.decode_step(params, cache, tokens, active)
+        prep_decode()
+        cache, logits = do_decode(cache, 0)
         jax.block_until_ready(logits)  # compile + warmup
         decode_ms = []
-        for _ in range(new_steps):
+        for i in range(new_steps):
             t0 = time.perf_counter()
-            cache, logits = eng.decode_step(
-                params, cache, tokens, active
-            )
+            cache, logits = do_decode(cache, i + 1)
             jax.block_until_ready(logits)
             decode_ms.append((time.perf_counter() - t0) * 1e3)
 
@@ -1534,8 +1589,10 @@ def run_child_serving(max_devices: int, platform: str = "cpu") -> None:
         # retired numpy.percentile columns on canned latencies).
         pf, dc = np.asarray(prefill_ms), np.asarray(decode_ms)
         row = {
-            "layout": layout + ("_cm" if cm else ""),
+            "layout": layout + ("_cm" if cm else "")
+            + ("_paged" if paged else ""),
             "axis_size": size,
+            "paged": paged,
             "prefill_p50_ms": round(exact_quantile(prefill_ms, 50), 3),
             "prefill_p99_ms": round(exact_quantile(prefill_ms, 99), 3),
             "prefill_tokens_per_s": round(
@@ -1547,13 +1604,25 @@ def run_child_serving(max_devices: int, platform: str = "cpu") -> None:
                 num_slots * len(dc) / (dc.sum() / 1e3), 1
             ),
         }
+        if paged:
+            # The PagedAttention accounting claim, from the pool
+            # bookkeeping: allocated pages track live tokens
+            # (p_len + decoded steps per slot), never slots*max_len.
+            contiguous = num_slots * eng._slot_stripe_bytes
+            row["kv_cache_bytes"] = host.pool.kv_cache_bytes
+            row["contiguous_kv_bytes"] = contiguous
+            row["kv_bytes_saved_pct"] = round(
+                100.0 * (1 - host.pool.kv_cache_bytes / contiguous), 1
+            )
+            row["kv_bytes_saved_at_prefill_pct"] = round(
+                100.0 * (1 - prefill_kv_bytes / contiguous), 1
+            )
         if layout == "tp":
             # The lint matrix's serving combos are the tp decode step
-            # (declarative and opted-in rings).
-            _with_predicted(
-                row, f"serve/S{size}" + ("/cm" if cm else ""),
-                measured_key="decode_p50_ms",
-            )
+            # (declarative, opted-in rings, and the paged twins).
+            nm = f"serve/S{size}" + ("/pg8" if paged else "") \
+                + ("/cm" if cm else "")
+            _with_predicted(row, nm, measured_key="decode_p50_ms")
         rows.append(row)
         log(f"{row['layout']} S={size}: prefill p50 "
             f"{row['prefill_p50_ms']}ms, decode p50 "
@@ -1562,8 +1631,120 @@ def run_child_serving(max_devices: int, platform: str = "cpu") -> None:
         # Per-leg partial line (same convention as the other sweeps).
         print(json.dumps({"leg": row, "partial": True}), flush=True)
 
+    # --- admission leg: chunked prefill vs monolithic under a mixed
+    # long-prompt/short-decode trace (Orca's iteration-level claim as
+    # numbers: p99 TTFT and useful-slots-per-iteration, both from the
+    # scheduler's existing report path). The monolithic deficiency the
+    # ISSUE names is PADDING: every admission — a 3-token short
+    # included — pays a prefill_len-padded compile sized for the
+    # longest prompt, so a queue of shorts drains prefill_len/prompt
+    # times slower than it should; the chunked engine pays
+    # ceil(prompt/chunk) small chunks instead, and decode interleaves
+    # with each one. Sized compute-dominant (dim 256) so the padding
+    # waste, not CPU dispatch overhead, is what's measured.
+    adm_max_len = 160
+    adm_cfg = GPTConfig(
+        vocab_size=128, dim=256, num_layers=2, num_heads=4,
+        ffn_dim=1024, max_position=adm_max_len, dropout_rate=0.0,
+    )
+
+    def admission_trace():
+        r = np.random.RandomState(1)
+        reqs = [Request(
+            rid=0,
+            prompt=r.randint(1, 128, size=120).astype(np.int32),
+            max_new_tokens=16,
+        )]
+        reqs += [Request(
+            rid=1 + i,
+            prompt=r.randint(
+                1, 128, size=int(r.randint(3, 13))
+            ).astype(np.int32),
+            max_new_tokens=4,
+        ) for i in range(20)]
+        return reqs
+
+    admission = {}
+    for mode, chunk in (("monolithic", None), ("chunked", 16)):
+        eng = ServingEngine(
+            adm_cfg, layout="replicated", num_slots=4,
+            max_len=adm_max_len,
+            prefill_len=128 if chunk is None else 16,
+            page_size=16, prefill_chunk=chunk,
+        )
+        params = eng.init_params(jax.random.PRNGKey(0))
+        eng.run(params, admission_trace())  # warmup compiles
+        sched = eng.run(params, admission_trace())
+        rep = sched.latency_report()
+        admission[mode] = {
+            "prefill_chunk": chunk,
+            "ttft_p99_ms": rep["ttft_p99_ms"],
+            "ttft_p50_ms": rep["prefill_p50_ms"],
+            "mean_iter_occupancy": rep["mean_iter_occupancy"],
+            "mean_batch_occupancy": rep["mean_batch_occupancy"],
+            "tokens_per_s": rep["tokens_per_s"],
+        }
+    mono, chnk = admission["monolithic"], admission["chunked"]
+    admission["ttft_p99_improvement_pct"] = round(
+        100.0 * (1 - chnk["ttft_p99_ms"] / mono["ttft_p99_ms"]), 1
+    ) if mono["ttft_p99_ms"] else None
+    admission["iter_occupancy_improvement_pct"] = round(
+        100.0 * (chnk["mean_iter_occupancy"]
+                 / mono["mean_iter_occupancy"] - 1), 1
+    ) if mono["mean_iter_occupancy"] else None
+    log(f"admission: ttft p99 {mono['ttft_p99_ms']} -> "
+        f"{chnk['ttft_p99_ms']} ms, iter occupancy "
+        f"{mono['mean_iter_occupancy']} -> "
+        f"{chnk['mean_iter_occupancy']}")
+    print(json.dumps(
+        {"leg": {"admission": admission}, "partial": True}
+    ), flush=True)
+
+    # --- prefix-cache leg: a repeated system prompt across requests —
+    # reused pages skip their prefill entirely.
+    sys_prompt = rng.randint(1, cfg.vocab_size, size=24).astype(
+        np.int32
+    )
+    prefix_reqs = [
+        Request(
+            rid=i,
+            prompt=np.concatenate([
+                sys_prompt,
+                rng.randint(1, cfg.vocab_size, size=4).astype(np.int32),
+            ]),
+            max_new_tokens=4,
+        )
+        for i in range(6)
+    ]
+    prefix = {}
+    for mode, pc in (("off", False), ("on", True)):
+        eng = ServingEngine(
+            cfg, layout="replicated", num_slots=2, max_len=max_len,
+            prefill_len=p_len, page_size=page_size, prefill_chunk=8,
+            prefix_cache=pc,
+        )
+        params = eng.init_params(jax.random.PRNGKey(0))
+        eng.run(params, list(prefix_reqs))  # warmup compiles
+        sched = eng.run(params, list(prefix_reqs))
+        rep = sched.latency_report()
+        prefix[mode] = {
+            "ttft_p99_ms": rep["ttft_p99_ms"],
+            "tokens_per_s": rep["tokens_per_s"],
+            "prefix_hit_pct": (
+                rep.get("prefix_cache", {}).get("prefix_hit_pct", 0.0)
+            ),
+        }
+    log(f"prefix cache: hit {prefix['on']['prefix_hit_pct']}% of "
+        f"prompt tokens, ttft p99 {prefix['off']['ttft_p99_ms']} -> "
+        f"{prefix['on']['ttft_p99_ms']} ms")
+    print(json.dumps({"leg": {"prefix_cache": prefix},
+                      "partial": True}), flush=True)
+
     out = {
         "serving_microbench": rows,
+        "serving_admission": admission,
+        "serving_prefix": prefix,
+        "page_size": page_size,
         "platform": jax.devices()[0].platform,
         "device_kind": jax.devices()[0].device_kind,
         "model": {

@@ -68,6 +68,15 @@ class Combo:
     bucket_mb: Optional[float] = None
     overlap_stages: int = 0
 
+    # Paged serving knobs (engine == "serve", ISSUE 15): page_size
+    # None keeps the PR 7 contiguous slot cache (every pre-existing
+    # serve combo name and ledger row byte-stable); set = the
+    # block-paged decode step. prefill_chunk shapes the HOST ingest
+    # loop only (same compiled decode step) and rides the name for the
+    # tuner's plan identity.
+    page_size: Optional[int] = None
+    prefill_chunk: int = 0
+
     @property
     def name(self) -> str:
         bits = [self.engine, f"S{self.size}"]
@@ -85,6 +94,10 @@ class Combo:
             bits.append(f"b{self.bucket_mb:g}")
         if self.overlap_stages:
             bits.append(f"seg{self.overlap_stages}")
+        if self.page_size is not None:
+            bits.append(f"pg{self.page_size}")
+        if self.prefill_chunk:
+            bits.append(f"ck{self.prefill_chunk}")
         if self.model != "mlp":
             bits.append(self.model)
         if self.collective_matmul:
@@ -856,14 +869,32 @@ def _build_serve(combo: Combo, devices):
         cfg, mesh, layout="tp", num_slots=2 * s, max_len=16,
         prefill_len=8, collective_matmul=combo.collective_matmul,
         compute_dtype=jnp.bfloat16 if combo.bf16 else None,
+        page_size=combo.page_size,
     )
     params = eng.init_params(jax.random.PRNGKey(0))
     cache = eng.init_cache()
     tokens = jnp.zeros((eng.num_slots,), jnp.int32)
     active = jnp.ones((eng.num_slots,), jnp.bool_)
-    hlo = eng.decode_step.lower(
-        params, cache, tokens, active
-    ).compile().as_text()
+    if combo.page_size is not None:
+        # The paged step: block-table gathers/scatters are LOCAL
+        # indexing ops, so the decode collective inventory — and
+        # therefore every rule expectation below — must be identical
+        # to the contiguous step's (the acceptance pin: paging never
+        # buys memory with extra wire traffic).
+        host = eng.new_host()
+        for slot in range(eng.num_slots):
+            host.ensure_pages(slot, 8)
+        positions = jnp.full((eng.num_slots,), 8, jnp.int32)
+        hlo = eng.decode_step.lower(
+            params, cache, host.device_table(), positions, tokens,
+            active,
+        ).compile().as_text()
+        n_donated = 2  # the paged cache donates {k, v}
+    else:
+        hlo = eng.decode_step.lower(
+            params, cache, tokens, active
+        ).compile().as_text()
+        n_donated = 3  # {k, v, lengths}
     expected = (
         decode_ring_permutes(cfg.num_layers, s)
         if combo.collective_matmul else None
@@ -878,8 +909,8 @@ def _build_serve(combo: Combo, devices):
         # serve-decode-ring's.
         cm_min_ring_permutes=expected or 0,
         serve_decode_permutes=expected,
-        # The decode step donates the 3 cache leaves (k, v, lengths).
-        n_param_leaves=3,
+        # The decode step donates the cache leaves.
+        n_param_leaves=n_donated,
         **_mesh_facts(mesh),
     )
     return target, hlo, mesh
@@ -956,6 +987,15 @@ def full_matrix() -> List[Combo]:
     for s in (2, 4):  # serving decode step, declarative + opted-in
         combos.append(Combo("serve", s))
         combos.append(Combo("serve", s, collective_matmul=True))
+    # Paged serving decode (ISSUE 15): the block-table gathers must
+    # not change the decode collective inventory — same serve-decode-
+    # ring pin (4L(S-1) tagged permutes, zero monolithic all-gather)
+    # on the paged step, declarative and opted-in.
+    combos.append(Combo("serve", 2, page_size=8))
+    combos.append(Combo("serve", 2, page_size=8,
+                        collective_matmul=True))
+    combos.append(Combo("serve", 4, page_size=8,
+                        collective_matmul=True))
     combos += [Combo("pipeline", 2), Combo("pipeline", 4)]
     combos.append(Combo("tp", 4, collective_matmul=True, bf16=True))
     combos.append(Combo("sp", 4, collective_matmul=True, bf16=True))

@@ -573,6 +573,81 @@ def check_serving_args(args) -> None:
             "'model' axis; it requires --layout tp with "
             "--model-shards >= 2"
         )
+    # --- paged-cache knobs (serving/kv_cache.py) ---------------------
+    if args.page_size < 0:
+        raise SystemExit(
+            f"--page-size must be >= 0, got {args.page_size}"
+        )
+    if args.page_size:
+        if args.max_len % args.page_size:
+            raise SystemExit(
+                f"--page-size {args.page_size} must divide --max-len "
+                f"{args.max_len} (the block table covers whole pages)"
+            )
+        if args.layout == "sp" and args.page_size % args.seq_shards:
+            raise SystemExit(
+                f"--layout sp shards each page's positions over "
+                f"'seq': --page-size {args.page_size} must be "
+                f"divisible by --seq-shards {args.seq_shards}"
+            )
+    else:
+        for val, flag in ((args.kv_pages, "--kv-pages"),
+                          (args.prefill_chunk, "--prefill-chunk")):
+            if val:
+                raise SystemExit(
+                    f"{flag} configures the block-paged KV cache; set "
+                    "--page-size as well (0 = contiguous slots)"
+                )
+        if args.prefix_cache:
+            raise SystemExit(
+                "--prefix-cache shares pool PAGES between slots; it "
+                "requires --page-size (the contiguous layout has no "
+                "sharable unit)"
+            )
+    if args.kv_pages < 0:
+        raise SystemExit(
+            f"--kv-pages must be >= 0, got {args.kv_pages}"
+        )
+    if args.prefill_chunk < 0:
+        raise SystemExit(
+            f"--prefill-chunk must be >= 0, got {args.prefill_chunk}"
+        )
+    if args.prefill_chunk and args.layout == "sp":
+        raise SystemExit(
+            "--prefill-chunk is not supported under --layout sp: sp "
+            "prefill rides the training ring over 'seq' in one pass — "
+            "drop the flag or use the replicated/tp layouts"
+        )
+    if args.prefix_cache:
+        if args.layout == "sp":
+            raise SystemExit(
+                "--prefix-cache is not supported under --layout sp "
+                "(shared pages would need coherent copy-on-write "
+                "across 'seq' shards)"
+            )
+        if not args.prefill_chunk:
+            raise SystemExit(
+                "--prefix-cache needs --prefill-chunk: a partial "
+                "prefix hit resumes ingestion mid-prompt, which only "
+                "the chunked path can do"
+            )
+    # --- sampling knobs (serving/sampling.py) ------------------------
+    if args.temperature < 0:
+        raise SystemExit(
+            f"--temperature must be >= 0, got {args.temperature}"
+        )
+    if args.top_k < 0:
+        raise SystemExit(f"--top-k must be >= 0, got {args.top_k}")
+    if not 0 < args.top_p <= 1:
+        raise SystemExit(
+            f"--top-p must be in (0, 1], got {args.top_p}"
+        )
+    if args.temperature == 0 and (args.top_k or args.top_p < 1):
+        raise SystemExit(
+            "--top-k/--top-p filter a SAMPLING distribution; with the "
+            "greedy default (--temperature 0) they would silently do "
+            "nothing — set --temperature > 0"
+        )
 
 
 def compute_dtype_from_flag(name: str):

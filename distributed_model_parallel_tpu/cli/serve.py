@@ -86,6 +86,44 @@ def build_parser() -> argparse.ArgumentParser:
                    help="cache positions per slot (prompt + generated)")
     p.add_argument("--prefill-len", default=64, type=int,
                    help="padded prompt length (one prefill compile)")
+    # Block paging (PagedAttention; serving/kv_cache.py).
+    p.add_argument("--page-size", default=0, type=int,
+                   help="block-paged KV cache: pool pages of this many "
+                        "positions reached through a per-slot block "
+                        "table — allocation scales with live tokens, "
+                        "not slots*max_len; must divide --max-len "
+                        "(0 = the contiguous slot layout)")
+    p.add_argument("--kv-pages", default=0, type=int,
+                   help="page-pool size in pages (needs --page-size; "
+                        "0 = num_slots * max_len/page_size, the "
+                        "no-risk worst case — smaller pools are the "
+                        "memory win, bounded by live tokens)")
+    p.add_argument("--prefill-chunk", default=0, type=int,
+                   help="chunked prefill: ingest prompts this many "
+                        "tokens per engine iteration, interleaved with "
+                        "in-flight decode so a long prompt never "
+                        "stalls the batch (needs --page-size; also "
+                        "lifts the --prefill-len prompt cap; 0 = "
+                        "monolithic prefill)")
+    p.add_argument("--prefix-cache", action="store_true",
+                   help="prompt caching: share immutable prefix pages "
+                        "between slots keyed on token prefix — a "
+                        "repeated system prompt skips its prefill; "
+                        "copy-on-write on the first divergent write "
+                        "(needs --page-size and --prefill-chunk)")
+    # Decode-time sampling (serving/sampling.py; greedy default is
+    # bit-stable — temperature 0 never touches an RNG).
+    p.add_argument("--temperature", default=0.0, type=float,
+                   help="sampling temperature (0 = greedy argmax, the "
+                        "bit-stable default)")
+    p.add_argument("--top-k", default=0, type=int,
+                   help="keep only the k most probable tokens before "
+                        "sampling (0 = no cut; needs --temperature "
+                        "> 0)")
+    p.add_argument("--top-p", default=1.0, type=float,
+                   help="nucleus sampling: keep the smallest prefix of "
+                        "probability mass reaching p (1 = no cut; "
+                        "needs --temperature > 0)")
     p.add_argument("--trace-out", default=None, metavar="PATH",
                    help="dump a Chrome trace_event JSON of the run "
                         "(per-request admission/prefill/decode spans, "
@@ -209,10 +247,16 @@ def main(argv=None) -> dict:
             f"--prompt-len-min/max must satisfy 1 <= min <= max, got "
             f"[{args.prompt_len_min}, {args.prompt_len_max}]"
         )
-    if args.prompt_len_max > args.prefill_len:
+    # Chunked prefill ingests in place, so only the cache caps prompt
+    # length; monolithic prefill pads to one --prefill-len compile.
+    prompt_cap = (
+        args.max_len - 1 if args.prefill_chunk else args.prefill_len
+    )
+    if args.prompt_len_max > prompt_cap:
         raise SystemExit(
             f"--prompt-len-max {args.prompt_len_max} exceeds "
-            f"--prefill-len {args.prefill_len}"
+            + (f"--max-len - 1 = {prompt_cap}" if args.prefill_chunk
+               else f"--prefill-len {prompt_cap}")
         )
     initialize_backend()
     cfg = GPTConfig(
@@ -260,6 +304,10 @@ def main(argv=None) -> dict:
         prefill_len=args.prefill_len,
         collective_matmul=args.collective_matmul,
         compute_dtype=compute_dtype_from_flag(args.dtype),
+        page_size=args.page_size or None,
+        num_pages=args.kv_pages or None,
+        prefill_chunk=args.prefill_chunk or None,
+        prefix_cache=args.prefix_cache,
     )
     if args.checkpoint:
         import jax.numpy as jnp
@@ -299,7 +347,17 @@ def main(argv=None) -> dict:
         from distributed_model_parallel_tpu.observability import trace
 
         trace.enable()
-    sched = engine.run(params, requests)
+    sampling = None
+    if args.temperature > 0:
+        from distributed_model_parallel_tpu.serving.sampling import (
+            SamplingConfig,
+        )
+
+        sampling = SamplingConfig(
+            temperature=args.temperature, top_k=args.top_k,
+            top_p=args.top_p, seed=args.seed,
+        )
+    sched = engine.run(params, requests, sampling=sampling)
     report = sched.latency_report()
     if args.metrics_out:
         from distributed_model_parallel_tpu.cli.common import (
@@ -336,6 +394,10 @@ def main(argv=None) -> dict:
             "num_slots": args.num_slots,
             "max_len": args.max_len,
             "prefill_len": args.prefill_len,
+            "page_size": args.page_size or None,
+            "prefill_chunk": args.prefill_chunk or None,
+            "prefix_cache": args.prefix_cache,
+            "temperature": args.temperature,
             **report,
         },
         "requests": per_request,

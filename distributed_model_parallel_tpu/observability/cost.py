@@ -237,6 +237,66 @@ def hierarchical_all_to_all_s(elems: int, itemsize: int, ici: int,
     )
 
 
+def serve_paged_request_s(live_tokens: int, prompt_tokens: int,
+                          new_tokens: int, token_bytes: int,
+                          page_size: int, prefill_chunk: int,
+                          constants: Optional[Dict[str, float]] = None,
+                          ) -> float:
+    """Per-request serving cost of one paged-cache configuration
+    (ISSUE 15 / ROADMAP 5c — the serve tuning family's closed form).
+
+    Two knob-driven tradeoffs, both alpha-beta shaped and both
+    EXHIBITED by the compiled/host path (the gather side of the decode
+    step reads the full block-table width whatever the page size, so
+    it is knob-neutral and deliberately NOT modeled):
+
+    * **page_size** — each decode step scatters back ONE whole page
+      per slot (`_scatter_written_page`): page_size * token_bytes of
+      write traffic per generated token (beta — larger pages rewrite
+      more unchanged positions), against ceil(total/p) page
+      allocations per sequence lifetime (alpha — smaller pages
+      allocate, and grow the block table, more often).
+    * **prefill_chunk** — ingestion runs ceil(prompt/c) chunk launches
+      (alpha) over ceil(prompt/c)*c padded token positions of compute
+      traffic (beta — the padding waste a smaller chunk trims), per
+      admitted request.
+
+    `live_tokens` (the batch's concurrent token load) is accepted for
+    payload-shape stability but deliberately UNPRICED: the per-request
+    form charges only this request's own pages, and the batch-level
+    gather is knob-neutral (above). Priced with the ICI constant pair
+    as the on-chip (HBM) proxy — the same CPU-physics honesty note as
+    every other closed form here: on this sandbox the constants rank
+    configurations, they do not predict wall clock on real silicon.
+
+    Both knobs must be >= 1: this form prices PAGED, CHUNKED
+    configurations only (0 is the CLI/Combo sentinel for
+    contiguous/monolithic, which has no page or chunk tradeoff to
+    price)."""
+    if page_size < 1 or prefill_chunk < 1:
+        raise ValueError(
+            "serve_paged_request_s prices paged+chunked serving: "
+            f"page_size ({page_size}) and prefill_chunk "
+            f"({prefill_chunk}) must be >= 1 (0 is the "
+            "contiguous/monolithic sentinel, which this form cannot "
+            "price)"
+        )
+    del live_tokens  # unpriced (docstring)
+    bw_ici, a_ici, _, _ = _resolve_constants(constants)
+    # Decode: one page of write-back per generated token (the written
+    # page rewrites in full), plus one allocation launch each time
+    # THIS sequence crosses into a new page over its lifetime.
+    total_tokens = prompt_tokens + new_tokens
+    decode_writes = new_tokens * (
+        a_ici + page_size * token_bytes / bw_ici
+    )
+    allocations = -(-total_tokens // page_size) * a_ici
+    chunks = -(-prompt_tokens // prefill_chunk)
+    prefill = chunks * a_ici \
+        + chunks * prefill_chunk * token_bytes / bw_ici
+    return prefill + decode_writes + allocations
+
+
 # ------------------------------------------------------ the HLO walker
 
 
@@ -387,6 +447,7 @@ __all__ = [
     "fabrics_from_constants",
     "flat_all_to_all_s",
     "hierarchical_all_to_all_s",
+    "serve_paged_request_s",
     "load_calibration",
     "predict_collectives",
     "ring_all_reduce_s",

@@ -91,6 +91,15 @@ METRIC_NAMES: Dict[str, str] = {
         "report time)"
     ),
     "serve_tokens_total": "generated tokens (counter)",
+    "serve_kv_pages_in_use": (
+        "live KV pages in the paged pool after the last engine "
+        "iteration (page-granular allocation scales with live tokens, "
+        "not slots*max_len — serving/kv_cache.py)"
+    ),
+    "serve_prefix_hits_total": (
+        "requests whose prompt reused >= 1 cached prefix page "
+        "(prompt caching; counter)"
+    ),
     # Checkpointing (checkpointing/save.py + writer.py).
     "ckpt_snapshot_s": "device->host snapshot half of a sharded save",
     "ckpt_background_write_s": "file-I/O half, on the writer thread",
@@ -109,6 +118,10 @@ TRACE_EVENT_NAMES: Dict[str, str] = {
         "token request leg (scheduler track)"
     ),
     "decode_step": "serving: one mixed-position batch decode step",
+    "prefill_chunk": (
+        "serving: one chunked-prefill ingest (prefill_chunk tokens of "
+        "one slot's prompt, sharing the iteration with decode)"
+    ),
     "queued": "serving request leg: submit -> admission",
     "decode": "serving request leg: first token -> eviction",
     "batch_occupancy": "serving counter: active slots per decode step",

@@ -79,6 +79,24 @@ def decode_stem(stem_params, tokens, positions, dtype):
     return h
 
 
+def chunk_stem(stem_params, ids, start, dtype):
+    """Chunked-prefill stem: (1, T) ids embedded at global positions
+    start + [0, T) with PER-TOKEN position gathers (clipped — padding
+    rows past the chunk's valid length may index beyond the table;
+    their outputs are discarded). `prefill_stem`'s dynamic_slice would
+    CLAMP the whole slice when start + T overruns the table, silently
+    shifting every position row — the per-token gather cannot."""
+    t = ids.shape[1]
+    pos_ids = jnp.clip(
+        start + jnp.arange(t), 0, stem_params["position"].shape[0] - 1
+    )
+    h = jnp.take(stem_params["word"], ids, axis=0) \
+        + jnp.take(stem_params["position"], pos_ids, axis=0)[None]
+    if dtype is not None:
+        h = h.astype(dtype)
+    return h
+
+
 def prefill_stem(stem_params, ids, offset, dtype):
     """Prompt stem over (B, T) ids starting at global position `offset`
     (0 for the dense layouts; the shard's global offset under 'seq'
@@ -143,6 +161,34 @@ class CacheAttention:
         return dot_product_attention(q, kc, vc, mask=valid)
 
 
+def _sp_online_softmax_attend(q, kc, vc, valid, axis):
+    """The exact cross-shard attention merge both sp decode recorders
+    share (contiguous AND paged — ONE copy, so the paged==contiguous
+    logit-parity pin can never be broken by the two drifting apart):
+    each shard scores q against ITS local keys under `valid`
+    (slots, local_kv), then the partial softmaxes combine via the
+    online recurrence — pmax of the running max, one psum each for the
+    exp-sums and weighted values."""
+    dh = q.shape[-1]
+    scale = 1.0 / jnp.sqrt(dh).astype(jnp.float32)
+    qf = q.astype(jnp.float32)
+    logits = jnp.einsum(
+        "bqhd,bkhd->bhqk", qf, kc.astype(jnp.float32)
+    ) * scale  # (slots, H, 1, local_kv)
+    neg = jnp.finfo(jnp.float32).min
+    logits = jnp.where(valid[:, None, None, :], logits, neg)
+    m = lax.pmax(jnp.max(logits, axis=-1), axis)  # (slots, H, 1)
+    p = jnp.exp(logits - m[..., None])
+    p = jnp.where(valid[:, None, None, :], p, 0.0)
+    denom = lax.psum(jnp.sum(p, axis=-1), axis)  # (slots, H, 1)
+    num = lax.psum(
+        jnp.einsum("bhqk,bkhd->bqhd", p, vc.astype(jnp.float32)),
+        axis,
+    )  # (slots, 1, H, Dh)
+    out = num / jnp.swapaxes(denom, 1, 2)[..., None]
+    return out.astype(q.dtype)
+
+
 class SeqShardedCacheAttention:
     """attention_fn for one traced decode step under the sp layout —
     call INSIDE shard_map over `axis`, with the cache's position axis
@@ -186,26 +232,7 @@ class SeqShardedCacheAttention:
         # so the union over shards is the dense prefix mask.
         gpos = idx * chunk + jnp.arange(chunk)
         valid = gpos[None, :] <= self.positions[:, None]  # (slots, C)
-        dh = q.shape[-1]
-        scale = 1.0 / jnp.sqrt(dh).astype(jnp.float32)
-        qf = q.astype(jnp.float32)
-        logits = jnp.einsum(
-            "bqhd,bkhd->bhqk", qf, kc.astype(jnp.float32)
-        ) * scale  # (slots, H, 1, C)
-        neg = jnp.finfo(jnp.float32).min
-        logits = jnp.where(valid[:, None, None, :], logits, neg)
-        # Online-softmax merge across shards (exact): shared running
-        # max, then one psum each for the exp-sums and weighted values.
-        m = lax.pmax(jnp.max(logits, axis=-1), self.axis)  # (slots,H,1)
-        p = jnp.exp(logits - m[..., None])
-        p = jnp.where(valid[:, None, None, :], p, 0.0)
-        denom = lax.psum(jnp.sum(p, axis=-1), self.axis)  # (slots,H,1)
-        num = lax.psum(
-            jnp.einsum("bhqk,bkhd->bqhd", p, vc.astype(jnp.float32)),
-            self.axis,
-        )  # (slots, 1, H, Dh)
-        out = num / jnp.swapaxes(denom, 1, 2)[..., None]
-        return out.astype(q.dtype)
+        return _sp_online_softmax_attend(q, kc, vc, valid, self.axis)
 
 
 class PrefillRecorder:
@@ -222,6 +249,237 @@ class PrefillRecorder:
         self.ks.append(k)
         self.vs.append(v)
         return self.core(q, k, v, mask)
+
+
+# ------------------------------------------------- paged attention fns
+#
+# The paged twins of the recorders above: K/V live in a page POOL
+# (L, num_pages, page_size, H, Dh) and each slot reaches its positions
+# through a block table (slots, pages_per_slot) of pool page ids (-1 =
+# unallocated). Every recorder gathers the slot's pages into the same
+# position-ordered view the contiguous cache stores directly — so the
+# attention math (and therefore the logits) is IDENTICAL, and only the
+# storage granularity changes. Gathers/scatters are local indexing ops,
+# never collectives, so the decode step's collective inventory (hlolint
+# `serve-decode-ring`) is untouched by paging.
+
+
+def _gather_pages(pool_layer, block_table):
+    """(num_pages, page, H, Dh) x (slots, P) -> position-ordered view
+    (slots, P*page, H, Dh). Unallocated entries (-1) clamp-gather page
+    0; their positions sit beyond every slot's live length, so the
+    validity masks keep them invisible."""
+    pages = jnp.take(
+        pool_layer, jnp.clip(block_table, 0, pool_layer.shape[0] - 1),
+        axis=0,
+    )  # (slots, P, page, H, Dh)
+    s, p, page, h, dh = pages.shape
+    return pages.reshape(s, p * page, h, dh)
+
+
+def _scatter_written_page(pool_layer, view, block_table, positions,
+                          active, page_size):
+    """Write back ONLY the page each slot's decode write landed in.
+    Inactive slots (and unallocated entries) scatter out of bounds and
+    drop — the pool is untouched for them. Distinct live slots write
+    distinct pool pages (the host's copy-on-write keeps write pages
+    private), so the scatter has no duplicate indices."""
+    s = view.shape[0]
+    num_pages = pool_layer.shape[0]
+    pages = view.reshape(
+        s, -1, page_size, view.shape[-2], view.shape[-1]
+    )
+    wp = positions // page_size  # (slots,) slot-local page index
+    written = jnp.take_along_axis(
+        pages, wp[:, None, None, None, None], axis=1
+    )[:, 0]  # (slots, page, H, Dh)
+    dst = jnp.take_along_axis(block_table, wp[:, None], axis=1)[:, 0]
+    dst = jnp.where(active & (dst >= 0), dst, num_pages)  # OOB -> drop
+    return pool_layer.at[dst].set(written, mode="drop")
+
+
+class PagedCacheAttention:
+    """attention_fn for one traced PAGED decode step, replicated/TP
+    layouts: gather the slot's pages through the block table, write the
+    new token at its own position, attend over the gathered view with
+    the same per-slot validity mask as `CacheAttention` (logit parity
+    is pinned paged == contiguous == dense), then scatter back only the
+    written page."""
+
+    def __init__(self, k, v, block_table, positions, active,
+                 page_size: int):
+        self.k = k  # (layers, num_pages, page, H, Dh)
+        self.v = v
+        self.bt = block_table  # (slots, pages_per_slot) int32
+        self.positions = positions  # (slots,) write/attend position
+        self.active = active  # (slots,) bool
+        self.page = page_size
+        self.layer = 0
+
+    def __call__(self, q, k_new, v_new, mask):
+        i = self.layer
+        self.layer += 1
+        kview = _gather_pages(self.k[i], self.bt)
+        vview = _gather_pages(self.v[i], self.bt)
+        kc = write_position(kview, k_new, self.positions, self.active)
+        vc = write_position(vview, v_new, self.positions, self.active)
+        self.k = self.k.at[i].set(_scatter_written_page(
+            self.k[i], kc, self.bt, self.positions, self.active,
+            self.page,
+        ))
+        self.v = self.v.at[i].set(_scatter_written_page(
+            self.v[i], vc, self.bt, self.positions, self.active,
+            self.page,
+        ))
+        valid = (
+            jnp.arange(kc.shape[1])[None, :] <= self.positions[:, None]
+        )
+        return dot_product_attention(q, kc, vc, mask=valid)
+
+
+class PagedSeqShardedCacheAttention:
+    """Paged attention_fn for one traced decode step under the sp
+    layout — call INSIDE shard_map over `axis`, with each PAGE's
+    position axis sharded: local pool (layers, num_pages, page/S, H,
+    Dh). Each shard owns positions [idx*psub, (idx+1)*psub) of EVERY
+    page, writes the new K/V only if it owns the slot's within-page
+    offset, and the per-shard partial softmaxes merge exactly via the
+    online recurrence (pmax/psum over `axis`) — the paged twin of
+    `SeqShardedCacheAttention`."""
+
+    def __init__(self, k, v, block_table, positions, active,
+                 page_size: int, *, axis: str = "seq"):
+        self.k = k
+        self.v = v
+        self.bt = block_table
+        self.positions = positions
+        self.active = active
+        self.page = page_size
+        self.axis = axis
+        self.layer = 0
+
+    def _local(self, view_len, psub):
+        """Global position of each local view element."""
+        f = jnp.arange(view_len)
+        idx = lax.axis_index(self.axis)
+        return (f // psub) * self.page + idx * psub + (f % psub)
+
+    def __call__(self, q, k_new, v_new, mask):
+        i = self.layer
+        self.layer += 1
+        psub = self.k.shape[2]  # page/S positions per shard
+        idx = lax.axis_index(self.axis)
+        kview = _gather_pages(self.k[i], self.bt)
+        vview = _gather_pages(self.v[i], self.bt)
+        # Write the new token if THIS shard owns its within-page
+        # offset; the local flat index of global position p is
+        # (p // page) * psub + (p % page) % psub.
+        p = self.positions
+        off = p % self.page
+        owns = (off // psub == idx) & self.active
+        local = (p // self.page) * psub + off % psub
+        kw = write_position(kview, k_new, local, owns)
+        vw = write_position(vview, v_new, local, owns)
+        self.k = self.k.at[i].set(_scatter_written_page(
+            self.k[i], kw, self.bt, local, owns, psub,
+        ))
+        self.v = self.v.at[i].set(_scatter_written_page(
+            self.v[i], vw, self.bt, local, owns, psub,
+        ))
+        gpos = self._local(kw.shape[1], psub)
+        valid = gpos[None, :] <= p[:, None]  # (slots, view)
+        return _sp_online_softmax_attend(q, kw, vw, valid, self.axis)
+
+
+class PagedChunkAttention:
+    """attention_fn for ONE chunked-prefill step of ONE slot
+    (replicated/TP layouts): the chunk's queries (positions
+    [start, start+n) for n = chunk length) attend causally over the
+    slot's already-cached prefix PLUS the chunk itself, and the
+    chunk's K/V lands in the slot's pages.
+
+    The write is a gather-from-chunk select over the whole view (no
+    dynamic-slice clamping hazards near max_len): view element at
+    global position g takes chunk element g - start when
+    start <= g < start + chunk. Chunk PADDING beyond the valid length
+    also lands in the view, but padding positions are either
+    overwritten by the next chunk / the first decode write (which
+    start exactly at start + n_valid) or sit beyond the slot's length
+    and stay masked — the same stale-tail discipline the contiguous
+    cache relies on. Scatter-back rewrites only the chunk//page + 1
+    pages the chunk region can touch (a static count; pages past the
+    block table or unallocated entries drop) — never the whole slot,
+    and never a prefix-cache SHARED page, since ingestion always
+    resumes at or after the matched boundary on freshly allocated
+    pages."""
+
+    def __init__(self, k, v, bt_row, start, page_size: int):
+        self.k = k
+        self.v = v
+        self.bt = bt_row  # (pages_per_slot,) int32
+        self.start = start  # int32 global position of chunk token 0
+        self.page = page_size
+        self.layer = 0
+
+    def _write_chunk(self, view, new):
+        """view (1, view_len, H, Dh) <- new (1, chunk, H, Dh) at
+        [start, start+chunk)."""
+        chunk = new.shape[1]
+        g = jnp.arange(view.shape[1])
+        c = jnp.clip(g - self.start, 0, chunk - 1)
+        cand = jnp.take(new[0], c, axis=0)[None].astype(view.dtype)
+        inside = (g >= self.start) & (g < self.start + chunk)
+        return jnp.where(inside[None, :, None, None], cand, view)
+
+    def _scatter_touched(self, pool_layer, view, chunk: int):
+        """Write back the slot-local pages overlapping
+        [start, start + chunk): the last touched page index is
+        (start + chunk - 1) // page, so with start possibly one short
+        of a boundary the span is at most (chunk-1)//page + 2 pages —
+        NOT chunk//page + 1, which undercounts whenever the chunk sits
+        unaligned (pinned by the logit-parity test at
+        prefill_chunk=3 / page_size=4). A trailing index past the real
+        span rewrites a just-gathered page with its own bytes."""
+        num_pages = pool_layer.shape[0]
+        pages = view.reshape(
+            -1, self.page, view.shape[-2], view.shape[-1]
+        )
+        idx = self.start // self.page + jnp.arange(
+            (chunk - 1) // self.page + 2
+        )
+        safe = jnp.clip(idx, 0, pages.shape[0] - 1)
+        touched = jnp.take(pages, safe, axis=0)
+        dst = jnp.take(self.bt, safe, axis=0)
+        ok = (idx < pages.shape[0]) & (dst >= 0)
+        dst = jnp.where(ok, dst, num_pages)  # OOB -> drop
+        return pool_layer.at[dst].set(touched, mode="drop")
+
+    def __call__(self, q, k_new, v_new, mask):
+        i = self.layer
+        self.layer += 1
+        chunk = k_new.shape[1]
+        kview = self._write_chunk(
+            _gather_pages(self.k[i], self.bt[None])[0][None], k_new
+        )
+        vview = self._write_chunk(
+            _gather_pages(self.v[i], self.bt[None])[0][None], v_new
+        )
+        self.k = self.k.at[i].set(
+            self._scatter_touched(self.k[i], kview, chunk)
+        )
+        self.v = self.v.at[i].set(
+            self._scatter_touched(self.v[i], vview, chunk)
+        )
+        # Causal across the prefix boundary: query at global position
+        # start + t sees every cached position <= start + t.
+        tq = q.shape[1]
+        qpos = self.start + jnp.arange(tq)
+        valid = (
+            jnp.arange(kview.shape[1])[None, :] <= qpos[:, None]
+        )  # (Tq, view)
+        return dot_product_attention(
+            q, kview, vview, mask=valid[None, None]
+        )
 
 
 # ---------------------------------------- decode-time collective matmul
@@ -318,8 +576,12 @@ def decode_ring_permutes(num_layers: int, size: int) -> int:
 __all__ = [
     "CacheAttention",
     "DecodeCollectiveMatmul",
+    "PagedCacheAttention",
+    "PagedChunkAttention",
+    "PagedSeqShardedCacheAttention",
     "PrefillRecorder",
     "SeqShardedCacheAttention",
+    "chunk_stem",
     "decode_ring_permutes",
     "decode_stem",
     "prefill_stem",

@@ -66,16 +66,30 @@ from distributed_model_parallel_tpu.runtime.compat import shard_map
 from distributed_model_parallel_tpu.serving.decode import (
     CacheAttention,
     DecodeCollectiveMatmul,
+    PagedCacheAttention,
+    PagedChunkAttention,
+    PagedSeqShardedCacheAttention,
     PrefillRecorder,
     SeqShardedCacheAttention,
+    chunk_stem,
     decode_stem,
     prefill_stem,
 )
 from distributed_model_parallel_tpu.serving.kv_cache import (
     KVCacheSpec,
+    PagedCacheHost,
+    PagedKVCacheSpec,
     cache_pspecs,
     cache_shardings,
+    copy_page,
     init_cache,
+    init_paged_cache,
+    paged_pspecs,
+    paged_shardings,
+)
+from distributed_model_parallel_tpu.serving.sampling import (
+    SamplingConfig,
+    SlotSampler,
 )
 from distributed_model_parallel_tpu.serving.scheduler import (
     Request,
@@ -98,6 +112,25 @@ class ServingEngine:
     collective_matmul: bool = False
     compute_dtype: Any = None  # activation dtype; None = f32
     donate: bool = True  # donate the cache buffers step-over-step
+    # --- block paging (PagedAttention; serving/kv_cache.py) ----------
+    # page_size None = the contiguous slot layout above; set = the
+    # page-pool layout: device K/V in (L, num_pages, page_size, H, Dh)
+    # pages reached through a host block table, page-granular
+    # alloc/free, logits pinned identical to the contiguous path.
+    page_size: Optional[int] = None
+    # Pool size in pages; None = num_slots * ceil(max_len/page_size)
+    # (worst case — a smaller pool is the memory win, bounded by live
+    # tokens).
+    num_pages: Optional[int] = None
+    # Chunked prefill: ingest prompts this many tokens per engine
+    # iteration, sharing iterations with in-flight decode (admission
+    # stops stalling the batch — Orca). None = monolithic prefill.
+    # Requires page_size; replicated/tp layouts.
+    prefill_chunk: Optional[int] = None
+    # Prefix caching: share immutable prompt pages between slots via a
+    # host-side token-prefix map (copy-on-write on the first divergent
+    # write). Requires page_size + prefill_chunk; replicated/tp.
+    prefix_cache: bool = False
 
     def __post_init__(self):
         cfg = self.cfg
@@ -124,6 +157,62 @@ class ServingEngine:
             head_dim=cfg.dim // cfg.num_heads, dtype=cache_dtype,
         )
         self.spec.validate(self.layout, self.mesh)
+        self.paged_spec = None
+        if self.page_size is None:
+            for flag, name in ((self.prefill_chunk, "prefill_chunk"),
+                               (self.num_pages, "num_pages")):
+                if flag is not None:
+                    raise ValueError(
+                        f"{name} configures the paged KV layout; set "
+                        "page_size as well (None = contiguous slots)"
+                    )
+            if self.prefix_cache:
+                raise ValueError(
+                    "prefix_cache shares POOL PAGES between slots; it "
+                    "requires page_size (the contiguous layout has no "
+                    "sharable unit)"
+                )
+        else:
+            pages_per_slot = -(-self.max_len // self.page_size)
+            self.paged_spec = PagedKVCacheSpec(
+                num_layers=cfg.num_layers, num_slots=self.num_slots,
+                max_len=self.max_len, page_size=self.page_size,
+                num_pages=(
+                    self.num_pages
+                    if self.num_pages is not None
+                    else self.num_slots * pages_per_slot
+                ),
+                num_heads=cfg.num_heads,
+                head_dim=cfg.dim // cfg.num_heads, dtype=cache_dtype,
+            )
+            self.paged_spec.validate(self.layout, self.mesh)
+            if self.prefill_chunk is not None:
+                if self.prefill_chunk < 1:
+                    raise ValueError(
+                        f"prefill_chunk must be >= 1, got "
+                        f"{self.prefill_chunk}"
+                    )
+                if self.layout == "sp":
+                    raise ValueError(
+                        "prefill_chunk is not supported under the sp "
+                        "layout: sp prefill rides the training ring "
+                        "over 'seq' in one pass (use monolithic "
+                        "prefill, or the replicated/tp layouts)"
+                    )
+            if self.prefix_cache:
+                if self.layout == "sp":
+                    raise ValueError(
+                        "prefix_cache is not supported under the sp "
+                        "layout (shared pages would need coherent "
+                        "copy-on-write across 'seq' shards)"
+                    )
+                if self.prefill_chunk is None:
+                    raise ValueError(
+                        "prefix_cache needs chunked prefill "
+                        "(prefill_chunk): a partial prefix hit resumes "
+                        "ingestion mid-prompt, which only the chunked "
+                        "path can do"
+                    )
         if self.collective_matmul and self.layout != "tp":
             raise ValueError(
                 "collective_matmul=True rings decode projections over "
@@ -188,6 +277,7 @@ class ServingEngine:
         mesh = self.mesh
         if mesh is None:
             self._param_sh = self._cache_sh = self._repl = None
+            self._paged_sh = None
             return
         self._repl = NamedSharding(mesh, P())
         if self.layout == "tp":
@@ -206,6 +296,10 @@ class ServingEngine:
         else:
             self._param_sh = self._repl
         self._cache_sh = cache_shardings(mesh, self.layout)
+        self._paged_sh = (
+            paged_shardings(mesh, self.layout)
+            if self.paged_spec is not None else None
+        )
 
     # ----------------------------------------------------------- steps
 
@@ -355,7 +449,145 @@ class ServingEngine:
             }
             return new_cache, next_logits
 
+        # --- paged twins: pool + block table instead of dense slots --
+        # `lengths` is NOT device state here — the host owns every
+        # slot's position along with the block table, so positions ride
+        # in as an argument and the cache pytree is exactly {k, v}.
+        paged = self.paged_spec
+        page = paged.page_size if paged else 0
+
+        def paged_decode_step(params, cache, bt, positions, tokens,
+                              active):
+            rec = PagedCacheAttention(
+                cache["k"], cache["v"], bt, positions, active, page
+            )
+            h = decode_stem(
+                params["stem"], tokens,
+                jnp.clip(positions, 0, cfg.max_position - 1), cdt,
+            )
+            mask = jnp.ones((num_slots, 1), jnp.bool_)
+            h = run_blocks(
+                params, (h, mask), rec,
+                dataclasses.replace(ctx, matmul=mm),
+            )
+            logits = head_apply(params["head"], h)[:, 0, :]
+            return {"k": rec.k, "v": rec.v}, logits
+
+        def sp_paged_decode_step(params, cache, bt, positions, tokens,
+                                 active):
+            rec = PagedSeqShardedCacheAttention(
+                cache["k"], cache["v"], bt, positions, active, page,
+                axis="seq",
+            )
+            h = decode_stem(
+                params["stem"], tokens,
+                jnp.clip(positions, 0, cfg.max_position - 1), cdt,
+            )
+            mask = jnp.ones((num_slots, 1), jnp.bool_)
+            h = run_blocks(params, (h, mask), rec, ctx)
+            logits = head_apply(params["head"], h)[:, 0, :]
+            return {"k": rec.k, "v": rec.v}, logits
+
+        def _scatter_slot_pages(buf, stack, bt_row):
+            """(L, p_len, H, Dh) full-prompt K or V -> the slot's pool
+            pages (drop unallocated entries)."""
+            n_pages = paged.pages_per_slot
+            pad = ((0, 0), (0, n_pages * page - p_len), (0, 0), (0, 0))
+            pages = jnp.pad(stack, pad).reshape(
+                stack.shape[0], n_pages, page, *stack.shape[2:]
+            ).astype(buf.dtype)
+            dst = jnp.where(bt_row >= 0, bt_row, paged.num_pages)
+            return buf.at[:, dst].set(pages, mode="drop")
+
+        def paged_prefill_step(params, cache, bt_row, ids, length):
+            mask = jnp.arange(p_len)[None, :] < length
+            h = prefill_stem(params["stem"], ids, 0, cdt)
+            rec = PrefillRecorder(
+                partial(dot_product_attention, causal=True)
+            )
+            h = run_blocks(params, (h, mask), rec, ctx)
+            logits = head_apply(params["head"], h)
+            next_logits = lax.dynamic_index_in_dim(
+                logits[0], length - 1, axis=0, keepdims=False
+            )
+            k_stack = jnp.stack([k[0] for k in rec.ks])
+            v_stack = jnp.stack([v[0] for v in rec.vs])
+            return {
+                "k": _scatter_slot_pages(cache["k"], k_stack, bt_row),
+                "v": _scatter_slot_pages(cache["v"], v_stack, bt_row),
+            }, next_logits
+
+        def sp_paged_prefill_step(params, cache, bt_row, ids, length):
+            s = self.mesh.shape["seq"]
+            tl = p_len // s
+            psub = page // s
+            idx = lax.axis_index("seq")
+            offset = idx * tl
+            gmask = (offset + jnp.arange(tl))[None, :] < length
+            h = prefill_stem(params["stem"], ids, offset, cdt)
+            rec = PrefillRecorder(
+                partial(ring_attention, axis_name="seq", causal=True)
+            )
+            h = run_blocks(params, (h, gmask), rec, ctx)
+            logits = head_apply(params["head"], h)
+            owner = (length - 1) // tl
+            li = jnp.clip(length - 1 - offset, 0, tl - 1)
+            row = jnp.where(
+                idx == owner,
+                lax.dynamic_index_in_dim(
+                    logits[0], li, axis=0, keepdims=False
+                ),
+                jnp.zeros((cfg.vocab_size,), jnp.float32),
+            )
+            next_logits = lax.psum(row, "seq")
+            n_pages = paged.pages_per_slot
+            pad = ((0, 0), (0, n_pages * page - p_len), (0, 0), (0, 0))
+
+            def my_pages(buf, stack):
+                full = jnp.pad(
+                    lax.all_gather(stack, "seq", axis=1, tiled=True),
+                    pad,
+                )  # (L, max_len, H, Dh)
+                pages = full.reshape(
+                    stack.shape[0], n_pages, page, *stack.shape[2:]
+                )
+                mine = lax.dynamic_slice_in_dim(
+                    pages, idx * psub, psub, axis=2
+                ).astype(buf.dtype)
+                dst = jnp.where(bt_row >= 0, bt_row, paged.num_pages)
+                return buf.at[:, dst].set(mine, mode="drop")
+
+            k_stack = jnp.stack([k[0] for k in rec.ks])
+            v_stack = jnp.stack([v[0] for v in rec.vs])
+            return {
+                "k": my_pages(cache["k"], k_stack),
+                "v": my_pages(cache["v"], v_stack),
+            }, next_logits
+
+        chunk = self.prefill_chunk or 0
+
+        def chunk_prefill_step(params, cache, bt_row, ids, start,
+                               n_valid):
+            rec = PagedChunkAttention(
+                cache["k"], cache["v"], bt_row, start, page
+            )
+            h = chunk_stem(params["stem"], ids, start, cdt)
+            mask = jnp.arange(chunk)[None, :] < n_valid
+            h = run_blocks(params, (h, mask), rec, ctx)
+            logits = head_apply(params["head"], h)
+            next_logits = lax.dynamic_index_in_dim(
+                logits[0], n_valid - 1, axis=0, keepdims=False
+            )
+            return {"k": rec.k, "v": rec.v}, next_logits
+
         donate = (1,) if self.donate else ()  # the cache argument
+        if paged is not None:
+            self._jit_paged_steps(
+                paged_decode_step, sp_paged_decode_step,
+                paged_prefill_step, sp_paged_prefill_step,
+                chunk_prefill_step, donate,
+            )
+            return
         if self.layout == "sp":
             mesh = self.mesh
             cspec = cache_pspecs("sp")
@@ -410,6 +642,90 @@ class ServingEngine:
                 prefill_step, donate_argnums=donate
             )
 
+    def _jit_paged_steps(self, decode_fn, sp_decode_fn, prefill_fn,
+                         sp_prefill_fn, chunk_fn, donate):
+        """Compile the paged step set. Public surface:
+
+        * `decode_step(params, cache, bt, positions, tokens, active)`
+        * `prefill(params, cache, bt_row, ids, length)` — monolithic
+        * `chunk_prefill(params, cache, bt_row, ids, start, n_valid)`
+          (only when `prefill_chunk` is set)
+        * `_copy_page(cache, src, dst)` — the COW kernel
+          `PagedCacheHost` calls
+        """
+        self.chunk_prefill = None
+        if self.layout == "sp":
+            mesh = self.mesh
+            cspec = paged_pspecs("sp")
+            self.decode_step = jax.jit(
+                shard_map(
+                    sp_decode_fn, mesh=mesh,
+                    in_specs=(P(), cspec, P(), P(), P(), P()),
+                    out_specs=(cspec, P()),
+                    check_vma=False,
+                ),
+                donate_argnums=donate,
+            )
+            self.prefill = jax.jit(
+                shard_map(
+                    sp_prefill_fn, mesh=mesh,
+                    in_specs=(P(), cspec, P(), P(None, "seq"), P()),
+                    out_specs=(cspec, P()),
+                    check_vma=False,
+                ),
+                donate_argnums=donate,
+            )
+            self._copy_page = jax.jit(
+                copy_page,
+                in_shardings=(self._paged_sh, self._repl, self._repl),
+                out_shardings=self._paged_sh,
+                donate_argnums=(0,),
+            )
+            return
+        if self.mesh is not None:
+            logits_sh = (
+                NamedSharding(self.mesh, P("model", None))
+                if self.layout == "tp" else self._repl
+            )
+            r = self._repl
+            self.decode_step = jax.jit(
+                decode_fn,
+                in_shardings=(
+                    self._param_sh, self._paged_sh, r, r, r, r,
+                ),
+                out_shardings=(self._paged_sh, logits_sh),
+                donate_argnums=donate,
+            )
+            self.prefill = jax.jit(
+                prefill_fn,
+                in_shardings=(self._param_sh, self._paged_sh, r, r, r),
+                out_shardings=(self._paged_sh, r),
+                donate_argnums=donate,
+            )
+            self._copy_page = jax.jit(
+                copy_page,
+                in_shardings=(self._paged_sh, r, r),
+                out_shardings=self._paged_sh,
+                donate_argnums=(0,),
+            )
+            if self.prefill_chunk:
+                self.chunk_prefill = jax.jit(
+                    chunk_fn,
+                    in_shardings=(
+                        self._param_sh, self._paged_sh, r, r, r, r,
+                    ),
+                    out_shardings=(self._paged_sh, r),
+                    donate_argnums=donate,
+                )
+            return
+        self.decode_step = jax.jit(decode_fn, donate_argnums=donate)
+        self.prefill = jax.jit(prefill_fn, donate_argnums=donate)
+        self._copy_page = jax.jit(copy_page, donate_argnums=(0,))
+        if self.prefill_chunk:
+            self.chunk_prefill = jax.jit(
+                chunk_fn, donate_argnums=donate
+            )
+
     # ------------------------------------------------------------ state
 
     def init_params(self, rng: jax.Array):
@@ -427,10 +743,28 @@ class ServingEngine:
         return jax.device_put(params, self._param_sh)
 
     def init_cache(self) -> dict:
+        if self.paged_spec is not None:
+            cache = init_paged_cache(self.paged_spec)
+            if self._paged_sh is None:
+                return cache
+            return jax.device_put(cache, self._paged_sh)
         cache = init_cache(self.spec)
         if self._cache_sh is None:
             return cache
         return jax.device_put(cache, self._cache_sh)
+
+    def new_host(self) -> PagedCacheHost:
+        """Fresh host half of the paged cache (block tables + page
+        pool + prefix map); one per `run` / test harness."""
+        if self.paged_spec is None:
+            raise ValueError(
+                "new_host() is the paged layout's bookkeeping; set "
+                "page_size"
+            )
+        return PagedCacheHost(
+            self.paged_spec, prefix_cache=self.prefix_cache,
+            copy_fn=self._copy_page,
+        )
 
     # ---------------------------------------------------------- serving
 
@@ -446,13 +780,48 @@ class ServingEngine:
         ids[0, : prompt.size] = prompt
         return jnp.asarray(ids), jnp.int32(prompt.size)
 
-    def run(self, params, requests: Sequence[Request]) -> Scheduler:
+    def _pick(self, sampler: Optional[SlotSampler], logits_row,
+              slot: int) -> int:
+        """Next token id: greedy argmax (bit-stable, the default) or
+        the per-slot sampling lane."""
+        row = np.asarray(logits_row)
+        if sampler is None:
+            return int(row.argmax())
+        return sampler.pick(row, slot)
+
+    @property
+    def _slot_stripe_bytes(self) -> int:
+        """Contiguous-equivalent bytes one live slot would pin (the
+        scheduler's SlotAllocator accounting seam)."""
+        s = self.spec
+        return (
+            2 * s.num_layers * s.max_len * s.num_heads * s.head_dim
+            * jnp.dtype(s.dtype).itemsize
+        )
+
+    def run(self, params, requests: Sequence[Request],
+            sampling: Optional[SamplingConfig] = None) -> Scheduler:
         """Offline continuous batching: drive the request set to
-        completion (greedy decoding), returning the Scheduler with its
-        per-request `finished` records and `latency_report()`."""
+        completion (greedy decoding by default; pass a SamplingConfig
+        for temperature/top-k/top-p with per-slot PRNG lanes),
+        returning the Scheduler with its per-request `finished` records
+        and `latency_report()`."""
+        sampler = (
+            SlotSampler(sampling, self.num_slots)
+            if sampling is not None and not sampling.greedy else None
+        )
+        if self.paged_spec is not None:
+            return self._run_paged(params, requests, sampler)
+        return self._run_contiguous(params, requests, sampler)
+
+    def _run_contiguous(self, params, requests: Sequence[Request],
+                        sampler: Optional[SlotSampler]) -> Scheduler:
         tracer = get_tracer()
         mx = get_metrics()  # per-call histograms; one branch when off
-        sched = Scheduler(self.num_slots, self.max_len)
+        sched = Scheduler(
+            self.num_slots, self.max_len,
+            bytes_per_slot=self._slot_stripe_bytes,
+        )
         for r in requests:
             if r.prompt.size > self.prefill_len:
                 raise ValueError(
@@ -474,8 +843,13 @@ class ServingEngine:
                     cache, next_logits = self.prefill(
                         params, cache, ids, length, jnp.int32(seq.slot)
                     )
-                    tok = int(np.asarray(next_logits).argmax())
+                    tok = self._pick(sampler, next_logits, seq.slot)
                 seq.t_first_token = tracer.now()
+                # A monolithic prefill is one engine iteration in which
+                # exactly ONE slot did useful work — the admission
+                # stall the chunked path removes, made visible in the
+                # iteration-occupancy series.
+                sched.record_iteration(1)
                 if mx.enabled:
                     mx.observe(
                         "serve_prefill_s", seq.t_first_token - t0
@@ -504,17 +878,246 @@ class ServingEngine:
                 logits_np = np.asarray(logits)
             dt = tracer.now() - t0
             sched.record_decode_step(n_active)
+            sched.record_iteration(n_active)
             tracer.counter("batch_occupancy", n_active)
             if mx.enabled:
                 mx.observe("serve_decode_step_s", dt)
             for slot, seq in list(sched.active.items()):
-                tok = int(logits_np[slot].argmax())
+                tok = self._pick(sampler, logits_np[slot], slot)
                 seq.generated.append(tok)
                 seq.token_times.append(dt)
                 tokens[slot] = tok
                 if seq.done(self.max_len):
                     sched.finish(slot)
                     active[slot] = False
+        return sched
+
+    # ----------------------------------------------------- paged loop
+
+    def _run_paged(self, params, requests: Sequence[Request],
+                   sampler: Optional[SlotSampler]) -> Scheduler:
+        """Continuous batching over the PAGE POOL: page-granular
+        admission, optional chunked prefill (one `prefill_chunk`-token
+        ingest per ingesting slot per engine iteration, SHARING the
+        iteration with the in-flight decode step — a long prompt never
+        stalls the batch), optional prefix caching (a cached prompt
+        skips its prefill; its last partial page copies on the first
+        divergent write)."""
+        tracer = get_tracer()
+        mx = get_metrics()
+        host = self.new_host()
+        sched = Scheduler(
+            self.num_slots, self.max_len,
+            bytes_per_slot=self._slot_stripe_bytes,
+        )
+        chunked = bool(self.prefill_chunk)
+        # Chunked ingestion walks the prompt in place, so the padded
+        # prefill_len compile no longer caps prompt length — only the
+        # cache (room for >= 1 generated token) does.
+        cap = (self.max_len - 1) if chunked else self.prefill_len
+        for r in requests:
+            if r.prompt.size > cap:
+                raise ValueError(
+                    f"request {r.rid!r}: prompt length {r.prompt.size} "
+                    f"exceeds "
+                    + (f"max_len - 1 = {cap}" if chunked
+                       else f"prefill_len {cap}")
+                )
+            sched.submit(r)
+        cache = self.init_cache()
+        positions = np.zeros((self.num_slots,), np.int32)
+        tokens = np.zeros((self.num_slots,), np.int32)
+        active = np.zeros((self.num_slots,), bool)
+        # slot -> [prompt, next ingest position, accumulated seconds]
+        ingest: dict = {}
+
+        def evict(slot):
+            sched.finish(slot)
+            active[slot] = False
+            host.release(slot)
+
+        while sched.has_work() or ingest:
+            useful = 0
+            # ---- admission: free slots AND page headroom -----------
+            # The headroom check budgets the WHOLE sequence (prompt +
+            # its max_new_tokens growth, capped by the cache) against
+            # the pool minus every already-admitted slot's outstanding
+            # commitment, and `reserve` records the same number — an
+            # admitted request can always allocate to completion; a
+            # request the pool cannot yet hold WAITS instead of
+            # crashing mid-ingest.
+            while sched.can_admit():
+                nxt = sched.waiting[0][1]
+                budget = min(
+                    int(nxt.prompt.size) + int(nxt.max_new_tokens),
+                    self.max_len,
+                )
+                if not host.can_hold(budget):
+                    break
+                seq = sched.admit()
+                host.reserve(seq.slot, budget)
+                prompt = seq.request.prompt
+                covered = host.attach_prefix(seq.slot, prompt)
+                if mx.enabled and host.prefix is not None:
+                    mx.inc(
+                        "serve_prefix_hits_total", 1 if covered else 0
+                    )
+                if not chunked:
+                    # Monolithic paged prefill: the padded one-compile
+                    # prompt ingest, landing in pages.
+                    host.ensure_pages(seq.slot, int(prompt.size))
+                    ids, length = self.pad_prompt(prompt)
+                    t0 = tracer.now()
+                    with tracer.span(
+                        "prefill", rid=repr(seq.request.rid),
+                        slot=seq.slot,
+                    ):
+                        cache, nl = self.prefill(
+                            params, cache,
+                            host.device_row(seq.slot), ids, length,
+                        )
+                        tok = self._pick(sampler, nl, seq.slot)
+                    seq.t_first_token = tracer.now()
+                    sched.record_iteration(1)
+                    if mx.enabled:
+                        mx.observe(
+                            "serve_prefill_s", seq.t_first_token - t0
+                        )
+                        mx.inc("serve_tokens_total", 1)
+                    seq.generated.append(tok)
+                    tokens[seq.slot] = tok
+                    positions[seq.slot] = prompt.size
+                    active[seq.slot] = True
+                    if seq.done(self.max_len):
+                        evict(seq.slot)
+                elif covered >= prompt.size - 1:
+                    # Full prefix hit: every needed position is cached
+                    # — SKIP prefill entirely and decode the last
+                    # prompt token at its own position. Its write page
+                    # copies first if shared (copy-on-write), via the
+                    # pre-decode ensure_writable pass every active
+                    # slot goes through below.
+                    positions[seq.slot] = prompt.size - 1
+                    tokens[seq.slot] = int(prompt[-1])
+                    active[seq.slot] = True
+                else:
+                    ingest[seq.slot] = [prompt, covered, 0.0]
+            # ---- ingestion: one chunk per ingesting slot -----------
+            for slot in sorted(ingest):
+                prompt, start, acc = ingest[slot]
+                seq = sched.active[slot]
+                n = min(self.prefill_chunk, int(prompt.size) - start)
+                host.ensure_pages(slot, start + n)
+                ids = np.zeros((1, self.prefill_chunk), np.int32)
+                ids[0, :n] = prompt[start:start + n]
+                t0 = tracer.now()
+                with tracer.span(
+                    "prefill_chunk", rid=repr(seq.request.rid),
+                    slot=slot, start=start,
+                ):
+                    cache, nl = self.chunk_prefill(
+                        params, cache, host.device_row(slot),
+                        jnp.asarray(ids), jnp.int32(start),
+                        jnp.int32(n),
+                    )
+                    done_ingest = start + n >= prompt.size
+                    if done_ingest:
+                        tok = self._pick(sampler, nl, slot)
+                dt = tracer.now() - t0
+                useful += 1
+                if done_ingest:
+                    seq.t_first_token = tracer.now()
+                    if mx.enabled:
+                        mx.observe("serve_prefill_s", acc + dt)
+                        mx.inc("serve_tokens_total", 1)
+                    seq.generated.append(tok)
+                    tokens[slot] = tok
+                    positions[slot] = prompt.size
+                    active[slot] = True
+                    host.register_prefix(slot, prompt)
+                    del ingest[slot]
+                    if seq.done(self.max_len):
+                        evict(slot)
+                else:
+                    ingest[slot][1] = start + n
+                    ingest[slot][2] = acc + dt
+            # ---- one decode step for the active set ----------------
+            n_active = int(active.sum())
+            if n_active:
+                for slot in np.nonzero(active)[0]:
+                    cache = host.ensure_writable(
+                        cache, int(slot), int(positions[slot])
+                    )
+                t0 = tracer.now()
+                with tracer.span("decode_step", active=n_active):
+                    cache, logits = self.decode_step(
+                        params, cache, host.device_table(),
+                        jnp.asarray(positions), jnp.asarray(tokens),
+                        jnp.asarray(active),
+                    )
+                    logits_np = np.asarray(logits)
+                dt = tracer.now() - t0
+                sched.record_decode_step(n_active)
+                tracer.counter("batch_occupancy", n_active)
+                if mx.enabled:
+                    mx.observe("serve_decode_step_s", dt)
+                useful += n_active
+                for slot, seq in list(sched.active.items()):
+                    if slot in ingest or not active[slot]:
+                        continue
+                    tok = self._pick(sampler, logits_np[slot], slot)
+                    first = not seq.generated
+                    if first:
+                        # A full prefix hit's first token arrives from
+                        # this decode step — its whole "prefill" was
+                        # the cache lookup.
+                        seq.t_first_token = tracer.now()
+                    else:
+                        seq.token_times.append(dt)
+                    seq.generated.append(tok)
+                    tokens[slot] = tok
+                    positions[slot] += 1
+                    if seq.done(self.max_len):
+                        evict(slot)
+            if mx.enabled:
+                mx.gauge(
+                    "serve_kv_pages_in_use", host.pool.pages_in_use
+                )
+            if useful:
+                sched.record_iteration(useful)
+            elif not ingest and not sched.active and sched.waiting:
+                raise RuntimeError(
+                    "page pool cannot hold the next waiting prompt "
+                    f"({int(sched.waiting[0][1].prompt.size)} tokens, "
+                    f"{host.pool.free_pages} free pages of "
+                    f"{self.paged_spec.page_size}) — size the pool "
+                    "larger (num_pages / --kv-pages)"
+                )
+        sched.paged_stats = {
+            "page_size": self.paged_spec.page_size,
+            "num_pages": self.paged_spec.num_pages,
+            "pages_in_use_peak": host.pages_in_use_peak,
+            "kv_cache_bytes_peak": (
+                host.pages_in_use_peak * self.paged_spec.page_bytes
+            ),
+            "contiguous_bytes": (
+                self.num_slots * self._slot_stripe_bytes
+            ),
+            "cow_copies": host.cow_copies,
+        }
+        if host.prefix is not None:
+            total_prompt = sum(
+                int(r.prompt.size) for r in requests
+            )
+            sched.prefix_stats = {
+                "hits": host.prefix.hits,
+                "misses": host.prefix.misses,
+                "tokens_reused": host.prefix.tokens_reused,
+                "prefix_hit_pct": round(
+                    100.0 * host.prefix.tokens_reused
+                    / max(total_prompt, 1), 2
+                ),
+            }
         return sched
 
 

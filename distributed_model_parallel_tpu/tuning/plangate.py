@@ -56,7 +56,8 @@ def grid() -> List[Cell]:
     """The pinned mesh x model grid (acceptance: >= 8 cells): both
     image reducer families on hybrid fabrics at two scales and both
     proxy models, the CausalLM-SP reducer plain and hybrid, the
-    hierarchical-MoE fabric at two scales, and the tp ring cell."""
+    hierarchical-MoE fabric at two scales, the tp ring cell, and the
+    paged-serving cell (page_size x prefill_chunk, ISSUE 15)."""
     return [
         Cell("ddp", 4, 2, "mlp"),
         Cell("ddp", 8, 2, "tinycnn"),
@@ -67,6 +68,7 @@ def grid() -> List[Cell]:
         Cell("ep", 4, 2),
         Cell("ep", 8, 2),
         Cell("tp", 4),
+        Cell("serve", 2),
     ]
 
 

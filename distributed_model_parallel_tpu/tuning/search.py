@@ -66,6 +66,19 @@ def cell_payload(cell: Cell) -> dict:
     no closed-form payload (its two candidates are both lowered)."""
     if cell.family == "tp":
         return {}
+    if cell.family == "serve":
+        # The lint serve proxy (`analysis/lint._build_serve`): GPT
+        # dim 16 / 2 layers / 4 heads serving 2S slots of a 16-position
+        # cache — K+V bytes per token across the stack, a half-full
+        # batch of live tokens, one 8-token prompt, 8 generated
+        # tokens. jax-free on purpose (closed-form-only family).
+        token_bytes = 2 * 2 * 16 * 4  # 2 (k+v) * layers * dim * f32
+        return {
+            "live_tokens": 2 * cell.size * 8,
+            "prompt_tokens": 8,
+            "new_tokens": 8,
+            "token_bytes": token_bytes,
+        }
     import jax
     import jax.numpy as jnp
 
@@ -179,6 +192,23 @@ def moe_closed_form_s(knobs: dict, elems: int, itemsize: int,
     )
 
 
+def serve_closed_form_s(knobs: dict, payload: dict,
+                        constants: Optional[Dict[str, float]] = None,
+                        ) -> float:
+    """Predicted per-request serving cost for one paged-cache
+    candidate — `cost.serve_paged_request_s` over the lint serve
+    proxy's payload (the page-overscan vs gather-launch and
+    chunk-padding vs chunk-launch tradeoffs, ISSUE 15)."""
+    from distributed_model_parallel_tpu.observability import cost
+
+    return cost.serve_paged_request_s(
+        payload["live_tokens"], payload["prompt_tokens"],
+        payload["new_tokens"], payload["token_bytes"],
+        knobs["page_size"], knobs["prefill_chunk"],
+        constants=constants,
+    )
+
+
 def closed_form_step_s(family: str, knobs: dict, payload: dict,
                        ici: int, dcn: int,
                        constants: Optional[Dict[str, float]] = None,
@@ -193,6 +223,8 @@ def closed_form_step_s(family: str, knobs: dict, payload: dict,
             knobs, payload["elems"], payload["itemsize"], ici, dcn,
             constants=constants,
         )
+    if family == "serve":
+        return serve_closed_form_s(knobs, payload, constants)
     return 0.0  # tp: both candidates are finalists; lowering decides
 
 
@@ -255,6 +287,16 @@ def candidate_combo(cell: Cell, knobs: dict):
         return Combo(
             "tp", cell.size,
             collective_matmul=knobs["collective_matmul"],
+        )
+    if cell.family == "serve":
+        # The paged decode step lowers per page_size; prefill_chunk
+        # shapes the HOST loop only (no compiled-step difference), so
+        # it rides the combo name for plan identity and the closed
+        # form decides it.
+        return Combo(
+            "serve", cell.size,
+            page_size=knobs["page_size"],
+            prefill_chunk=knobs["prefill_chunk"],
         )
     raise ValueError(f"no combo mapping for family {cell.family!r}")
 
@@ -393,4 +435,5 @@ __all__ = [
     "rank_candidates",
     "reducer_closed_form_s",
     "search_cell",
+    "serve_closed_form_s",
 ]

@@ -31,7 +31,7 @@ from typing import Dict, List, Optional, Tuple
 # matrix's combo vocabulary (`analysis/lint.py` builders), which is
 # what makes "price a candidate" a one-liner: every candidate maps to
 # a Combo the shared lowering path already understands.
-FAMILIES = ("ddp", "fsdp", "sp_lm", "ep", "tp")
+FAMILIES = ("ddp", "fsdp", "sp_lm", "ep", "tp", "serve")
 
 
 @dataclasses.dataclass(frozen=True)
@@ -76,6 +76,21 @@ SPACES: Dict[str, Tuple[Knob, ...]] = {
              "--dcn-compression", "dcn_compression"),
     ),
     "tp": (_CM_KNOB,),
+    # Serving (the paged KV cache, ISSUE 15 / ROADMAP 5c): page size
+    # trades per-token page WRITE-BACK bytes (the decode step rewrites
+    # one whole page per slot per step) against page-allocation
+    # launches over the sequence lifetime; prefill chunk trades
+    # per-chunk launches against padded prompt compute. Priced by the
+    # cost engine's closed form (`search.serve_closed_form_s`); the
+    # engine lives under serving/ (`scan_knob_surface` scans it
+    # alongside parallel/). Values sized to divide the lint serve
+    # proxy's 16-position cache — the same proxy-fits-the-grid
+    # compromise as _BUCKET_GRID's sub-MB values.
+    "serve": (
+        Knob("page_size", (4, 8, 16), "--page-size", "page_size"),
+        Knob("prefill_chunk", (4, 8, 16), "--prefill-chunk",
+             "prefill_chunk"),
+    ),
 }
 
 
@@ -138,6 +153,10 @@ def preference(family: str, knobs: dict) -> tuple:
             0 if knobs["overlap"] else 1,
             ("none", "bf16", "int8").index(knobs["dcn_compression"]),
         )
+    if family == "serve":
+        # Equal-cost ties break toward less HBM overscan (smaller
+        # pages), then fewer ingest launches (larger chunks).
+        return (knobs["page_size"], -knobs["prefill_chunk"])
     # tp: prefer the ring decomposition on a tie (latency hiding).
     return (0 if knobs["collective_matmul"] else 1,)
 
@@ -186,11 +205,14 @@ def scan_knob_surface() -> Dict[str, List[str]]:
     """Literal source scan backing the conftest META-CHECK: every knob
     the space enumerates must exist as (a) a CLI flag literal somewhere
     under `cli/` and (b) an engine dataclass field (annotated
-    attribute) somewhere under `parallel/`. Returns
+    attribute) somewhere under `parallel/` or `serving/` (the serve
+    family's engine lives in `serving/engine.py`). Returns
     {knob_name: [what's missing, ...]} — empty means the space and the
     real surfaces agree."""
     cli_src = _read_sources("cli")
-    engine_src = _read_sources("parallel")
+    engine_src = (
+        _read_sources("parallel") + "\n" + _read_sources("serving")
+    )
     strays: Dict[str, List[str]] = {}
     seen = set()
     for family, knob_list in sorted(SPACES.items()):
@@ -209,7 +231,7 @@ def scan_knob_surface() -> Dict[str, List[str]]:
             ):
                 missing.append(
                     f"engine field {knob.engine_param!r} not found "
-                    "under parallel/"
+                    "under parallel/ or serving/"
                 )
             if missing:
                 strays.setdefault(

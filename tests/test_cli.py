@@ -730,6 +730,111 @@ def test_serving_flag_guards():
         serve.main(["--model-shards", "4"])
     with pytest.raises(SystemExit):  # prompts must fit the prefill pad
         serve.main(["--prompt-len-max", "200", "--prefill-len", "64"])
+    # --- paged-cache knobs (ISSUE 15) ---
+    with pytest.raises(SystemExit):  # page must divide max_len
+        serve.main(["--page-size", "48", "--max-len", "64"])
+    with pytest.raises(SystemExit):  # chunking needs the paged layout
+        serve.main(["--prefill-chunk", "16"])
+    with pytest.raises(SystemExit):  # pool sizing needs the paged layout
+        serve.main(["--kv-pages", "8"])
+    with pytest.raises(SystemExit):  # sharing needs pages
+        serve.main(["--prefix-cache"])
+    with pytest.raises(SystemExit):  # prefix cache needs chunked ingest
+        serve.main(["--page-size", "16", "--prefix-cache"])
+    with pytest.raises(SystemExit):  # no chunked ingest under sp
+        serve.main(["--layout", "sp", "--seq-shards", "2",
+                    "--page-size", "16", "--prefill-chunk", "8"])
+    with pytest.raises(SystemExit):  # no page sharing under sp
+        serve.main(["--layout", "sp", "--seq-shards", "2",
+                    "--page-size", "16", "--prefill-chunk", "8",
+                    "--prefix-cache"])
+    with pytest.raises(SystemExit):  # page must split over seq shards
+        serve.main(["--layout", "sp", "--seq-shards", "4",
+                    "--page-size", "2", "--max-len", "64"])
+    # --- sampling knobs ---
+    with pytest.raises(SystemExit):  # top-k filters a sampling dist
+        serve.main(["--top-k", "8"])
+    with pytest.raises(SystemExit):  # top-p likewise
+        serve.main(["--top-p", "0.9"])
+    with pytest.raises(SystemExit):  # temperature >= 0
+        serve.main(["--temperature", "-1"])
+    with pytest.raises(SystemExit):  # top-p in (0, 1]
+        serve.main(["--temperature", "1", "--top-p", "1.5"])
+
+
+def test_serve_cli_paged_prefix(tmp_path):
+    """The paged serving surface end-to-end (tier-1): --page-size +
+    --prefill-chunk + --prefix-cache through the full CLI with
+    --metrics-out — the report carries the page-pool accounting and
+    prefix stats, and the new serve_kv_pages_in_use /
+    serve_prefix_hits_total series land on the exposition surface."""
+    import json
+
+    from distributed_model_parallel_tpu.cli import serve
+    from distributed_model_parallel_tpu.observability import metrics
+
+    mpath = tmp_path / "metrics.json"
+    try:
+        result = serve.main([
+            "--dim", "16", "--layers", "2", "--heads", "4",
+            "--ffn-dim", "32", "--vocab-size", "61",
+            "--num-slots", "2", "--max-len", "16", "--prefill-len", "8",
+            "--page-size", "4", "--prefill-chunk", "4",
+            "--prefix-cache",
+            "--num-requests", "6", "--prompt-len-min", "2",
+            "--prompt-len-max", "6", "--max-new-tokens", "3",
+            "--metrics-out", str(mpath),
+        ])
+    finally:
+        metrics.set_metrics(None)
+    srv = result["serving"]
+    assert srv["requests"] == 6
+    assert srv["page_size"] == 4 and srv["prefill_chunk"] == 4
+    assert srv["paged"]["pages_in_use_peak"] >= 1
+    # Bounded by the pool; the strict tokens-not-stripes pin lives in
+    # tests/test_serving_paged.py (the prefix cache deliberately KEEPS
+    # finished prompts' pages live for reuse, so a cache-on run may
+    # fill the pool).
+    assert srv["paged"]["kv_cache_bytes_peak"] <= \
+        srv["paged"]["contiguous_bytes"]
+    assert "prefix_cache" in srv
+    with open(mpath) as f:
+        exported = json.load(f)
+    assert "serve_kv_pages_in_use" in exported["gauges"]
+    assert "serve_prefix_hits_total" in exported["counters"]
+
+
+@pytest.mark.slow
+def test_serve_cli_sampling_greedy_bitstable():
+    """--temperature 0 (the default) is bit-stable: the sampled-path
+    flags left at their defaults produce byte-identical tokens to a
+    plain greedy run, and a --temperature run is deterministic for a
+    fixed --seed (per-slot PRNG lanes, serving/sampling.py). `slow`
+    (tier-1 budget); tier-1 twins: tests/test_serving_paged.py::
+    test_sampling_greedy_default_bit_stable +
+    test_sampling_deterministic_per_slot_lane (the engine-level pins
+    on the same sampler) and test_serving_flag_guards (the CLI flag
+    surface)."""
+    from distributed_model_parallel_tpu.cli import serve
+
+    base = [
+        "--dim", "16", "--layers", "2", "--heads", "4",
+        "--ffn-dim", "32", "--vocab-size", "61",
+        "--num-slots", "2", "--max-len", "16", "--prefill-len", "8",
+        "--num-requests", "3", "--prompt-len-min", "2",
+        "--prompt-len-max", "6", "--max-new-tokens", "3",
+    ]
+    greedy = serve.main(base)
+    greedy2 = serve.main(base + ["--temperature", "0"])
+    assert [r["tokens"] for r in greedy["requests"]] == \
+        [r["tokens"] for r in greedy2["requests"]]
+    s1 = serve.main(base + ["--temperature", "0.8", "--top-k", "16",
+                            "--top-p", "0.95"])
+    s2 = serve.main(base + ["--temperature", "0.8", "--top-k", "16",
+                            "--top-p", "0.95"])
+    assert [r["tokens"] for r in s1["requests"]] == \
+        [r["tokens"] for r in s2["requests"]]
+    assert s1["serving"]["temperature"] == 0.8
 
 
 def test_reference_split_builds_stages():

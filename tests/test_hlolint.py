@@ -37,6 +37,9 @@ TIER1_COMBOS = [
     # serving decode rings: exact tagged 4L(S-1) chain, no monolithic
     # all-gather on the opted-in step (serve-decode-ring)
     Combo("serve", 2, collective_matmul=True),
+    # the PAGED decode step must carry the identical inventory —
+    # block-table gathers are local ops, never collectives (ISSUE 15)
+    Combo("serve", 2, page_size=8, collective_matmul=True),
     # hierarchical MoE exchange on a hybrid fabric: exact moe_ring
     # chain + zero flat all-to-all (moe-hierarchical-a2a); the pre-gate
     # twin (tools/tier1.sh lints this exact combo before the suite)
