@@ -1473,21 +1473,39 @@ def run_child_serving(max_devices: int, platform: str = "cpu") -> None:
         vocab_size=128, dim=64, num_layers=2, num_heads=4, ffn_dim=128,
         max_position=max_len, dropout_rate=0.0,
     )
-    # (layout, axis size, collective_matmul, paged) — every contiguous
-    # leg has a paged twin so the table answers paged-vs-contiguous
-    # per leg (prefill and decode separately).
-    legs = [("replicated", 1, False, False),
-            ("replicated", 1, False, True)]
+    # (layout, axis size, collective_matmul, paged, compute_dtype) —
+    # every contiguous leg has a paged twin so the table answers
+    # paged-vs-contiguous per leg (prefill and decode separately), and
+    # the quantized decode legs (ISSUE 16) ride the same harness with
+    # f32 twins first so greedy-token stability is checked in-row.
+    legs = [("replicated", 1, False, False, "f32"),
+            ("replicated", 1, False, True, "f32")]
     for s in (2, 4):
         if s <= min(max_devices, len(devices)):
-            legs += [("tp", s, False, False), ("tp", s, True, False),
-                     ("tp", s, True, True), ("sp", s, False, False),
-                     ("sp", s, False, True)]
+            legs += [("tp", s, False, False, "f32"),
+                     ("tp", s, True, False, "f32"),
+                     ("tp", s, True, True, "f32"),
+                     ("sp", s, False, False, "f32"),
+                     ("sp", s, False, True, "f32")]
+    # Quantized decode floor: bf16/int8 at replicated plus the tp
+    # rings (the lint matrix's q- combos price these shapes; off-TPU
+    # the int8 GEMM takes the dtype-pinned XLA fallback, so the tok/s
+    # column is about dispatch overhead until a real slice runs it —
+    # predicted_ms carries the MXU-rate claim either way).
+    legs += [("replicated", 1, False, False, "bf16"),
+             ("replicated", 1, False, False, "int8")]
+    for s in (2, 4):
+        if s <= min(max_devices, len(devices)):
+            legs += [("tp", s, True, False, "int8")]
+    if 2 <= min(max_devices, len(devices)):
+        legs += [("tp", 2, False, False, "int8"),
+                 ("tp", 2, True, False, "bf16")]
     rng = np.random.RandomState(0)
     prompt = rng.randint(1, cfg.vocab_size, size=p_len).astype(np.int32)
 
     rows = []
-    for layout, size, cm, paged in legs:
+    greedy_ref = {}  # (layout, size, cm, paged) -> f32 argmax tokens
+    for layout, size, cm, paged, cdt in legs:
         mesh = None
         if layout != "replicated":
             spec = MeshSpec(
@@ -1501,6 +1519,7 @@ def run_child_serving(max_devices: int, platform: str = "cpu") -> None:
             cfg, mesh, layout=layout, num_slots=num_slots,
             max_len=max_len, prefill_len=p_len, collective_matmul=cm,
             page_size=page_size if paged else None,
+            compute_dtype=cdt,
         )
         params = eng.init_params(jax.random.PRNGKey(0))
         ids, length = eng.pad_prompt(prompt)
@@ -1577,11 +1596,15 @@ def run_child_serving(max_devices: int, platform: str = "cpu") -> None:
         cache, logits = do_decode(cache, 0)
         jax.block_until_ready(logits)  # compile + warmup
         decode_ms = []
+        greedy = []
         for i in range(new_steps):
             t0 = time.perf_counter()
             cache, logits = do_decode(cache, i + 1)
             jax.block_until_ready(logits)
             decode_ms.append((time.perf_counter() - t0) * 1e3)
+            # Outside the timed window: the per-step argmax trajectory
+            # for the quantized-vs-f32 greedy-stability column below.
+            greedy.append(np.asarray(logits).argmax(axis=-1).tolist())
 
         # p50/p99 via the repo's ONE percentile rule
         # (observability/metrics.exact_quantile — the same math the
@@ -1590,9 +1613,11 @@ def run_child_serving(max_devices: int, platform: str = "cpu") -> None:
         pf, dc = np.asarray(prefill_ms), np.asarray(decode_ms)
         row = {
             "layout": layout + ("_cm" if cm else "")
-            + ("_paged" if paged else ""),
+            + ("_paged" if paged else "")
+            + (f"_{cdt}" if cdt != "f32" else ""),
             "axis_size": size,
             "paged": paged,
+            "compute_dtype": cdt,
             "prefill_p50_ms": round(exact_quantile(prefill_ms, 50), 3),
             "prefill_p99_ms": round(exact_quantile(prefill_ms, 99), 3),
             "prefill_tokens_per_s": round(
@@ -1617,11 +1642,21 @@ def run_child_serving(max_devices: int, platform: str = "cpu") -> None:
             row["kv_bytes_saved_at_prefill_pct"] = round(
                 100.0 * (1 - prefill_kv_bytes / contiguous), 1
             )
+        # Greedy-token stability: the quantized leg must pick the SAME
+        # argmax tokens as its f32 twin across every decode step, or
+        # the compression is not free at temperature 0 on this config.
+        key = (layout, size, cm, paged)
+        if cdt == "f32":
+            greedy_ref[key] = greedy
+        elif key in greedy_ref:
+            row["greedy_matches_f32"] = greedy == greedy_ref[key]
         if layout == "tp":
             # The lint matrix's serving combos are the tp decode step
-            # (declarative, opted-in rings, and the paged twins).
+            # (declarative, opted-in rings, the paged twins, and the
+            # q- quantized variants).
             nm = f"serve/S{size}" + ("/pg8" if paged else "") \
-                + ("/cm" if cm else "")
+                + ("/cm" if cm else "") \
+                + (f"/q-{cdt}" if cdt != "f32" else "")
             _with_predicted(row, nm, measured_key="decode_p50_ms")
         rows.append(row)
         log(f"{row['layout']} S={size}: prefill p50 "

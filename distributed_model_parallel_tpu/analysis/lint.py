@@ -77,6 +77,13 @@ class Combo:
     page_size: Optional[int] = None
     prefill_chunk: int = 0
 
+    # Quantized decode arithmetic (engine == "serve", ISSUE 16): None
+    # keeps the f32 projections (every pre-existing serve combo name
+    # and ledger row byte-stable); "bf16"/"int8" opt the decode
+    # projection GEMMs into `ops/quant_matmul.py` (rule
+    # decode-quantized-matmul).
+    compute_dtype: Optional[str] = None
+
     @property
     def name(self) -> str:
         bits = [self.engine, f"S{self.size}"]
@@ -104,6 +111,8 @@ class Combo:
             bits.append("cm")
         if self.bf16:
             bits.append("bf16")
+        if self.compute_dtype is not None:
+            bits.append(f"q-{self.compute_dtype}")
         return "/".join(bits)
 
 
@@ -308,6 +317,32 @@ def _wire_chunk_expectations(plans, ici_size: int, dcn_size: int,
     return tuple(chunks)
 
 
+def _fsdp_gather_chunk_expectations(
+    full_leaf_shapes, dcn_size: int, dcn_compression: str,
+    gathers_per_leaf: int,
+):
+    """Expected (n_elems, wire_dtype) multiset of FSDP's compressed
+    WEIGHT-gather ring hops (ISSUE 16 satellite,
+    `parallel/fsdp._coded_dcn_gather`): each dcn-crossing leaf crosses
+    'dcn' in (K-1) coded hops of full_leaf/K elems per gather —
+    `gathers_per_leaf` is 1 for the single-entry steps, 2 under
+    "overlapped" (forward gather + backward regather)."""
+    if dcn_compression == "none" or dcn_size <= 1:
+        return ()
+    import math as _math
+
+    from distributed_model_parallel_tpu.analysis.rules import (
+        DCN_WIRE_TOKEN,
+    )
+
+    wire = DCN_WIRE_TOKEN[dcn_compression]
+    chunks = []
+    for shape in full_leaf_shapes:
+        hop = _math.prod(shape) // dcn_size
+        chunks += [(hop, wire)] * ((dcn_size - 1) * gathers_per_leaf)
+    return tuple(chunks)
+
+
 def _n_param_leaves(ts) -> int:
     import jax
 
@@ -374,6 +409,52 @@ def jaxpr_ppermute_dtypes(fn, *args):
     `jaxpr_ppermute_records` — the record shape `LintTarget.ring_dtypes`
     carries for the bf16-ring-upcast rule."""
     return tuple(r[:3] for r in jaxpr_ppermute_records(fn, *args))
+
+
+def jaxpr_dot_records(fn, *args):
+    """((lhs_dtype_token, rhs_dtype_token, rhs_shape), ...) for every
+    `dot_general` equation in fn's jaxpr, sub-jaxprs (pjit bodies,
+    shard_map fold bodies) included — the quant twin of
+    `jaxpr_ppermute_records`. Compiled CPU HLO normalizes int8/bf16
+    dots back to f32, so the `decode-quantized-matmul` rule pins the
+    compute-dtype contract from these trace-level records
+    (`LintTarget.decode_dot_records`)."""
+    import jax
+
+    closed = jax.make_jaxpr(fn)(*args)
+    out = []
+    seen = set()
+
+    def walk(jaxpr):
+        if id(jaxpr) in seen:
+            return
+        seen.add(id(jaxpr))
+        for eqn in jaxpr.eqns:
+            if eqn.primitive.name == "dot_general":
+                lhs = str(eqn.invars[0].aval.dtype)
+                rhs = str(eqn.invars[1].aval.dtype)
+                out.append((
+                    _DTYPE_TOKEN.get(lhs, lhs),
+                    _DTYPE_TOKEN.get(rhs, rhs),
+                    tuple(int(d) for d in eqn.invars[1].aval.shape),
+                ))
+            for v in eqn.params.values():
+                for sub in _subjaxprs(v):
+                    walk(sub)
+
+    def _subjaxprs(v):
+        import jax.core as core
+
+        if isinstance(v, core.ClosedJaxpr):
+            yield v.jaxpr
+        elif isinstance(v, core.Jaxpr):
+            yield v
+        elif isinstance(v, (tuple, list)):
+            for x in v:
+                yield from _subjaxprs(x)
+
+    walk(closed.jaxpr)
+    return tuple(out)
 
 
 def _mesh_facts(mesh):
@@ -494,6 +575,11 @@ def _build_data_engine(combo: Combo, devices):
         dcn_wire_chunks=_wire_chunk_expectations(
             plans, facts["ici_size"], facts["dcn_size"],
             combo.dcn_compression,
+        ),
+        dcn_gather_chunks=_fsdp_gather_chunk_expectations(
+            full_leaf_shapes, facts["dcn_size"],
+            combo.dcn_compression,
+            2 if combo.grad_reduction == "overlapped" else 1,
         ),
         dcn_ring_records=dcn_records,
         n_param_leaves=_n_param_leaves(ts), **facts,
@@ -868,7 +954,10 @@ def _build_serve(combo: Combo, devices):
     eng = ServingEngine(
         cfg, mesh, layout="tp", num_slots=2 * s, max_len=16,
         prefill_len=8, collective_matmul=combo.collective_matmul,
-        compute_dtype=jnp.bfloat16 if combo.bf16 else None,
+        compute_dtype=(
+            combo.compute_dtype
+            or (jnp.bfloat16 if combo.bf16 else None)
+        ),
         page_size=combo.page_size,
     )
     params = eng.init_params(jax.random.PRNGKey(0))
@@ -885,19 +974,30 @@ def _build_serve(combo: Combo, devices):
         for slot in range(eng.num_slots):
             host.ensure_pages(slot, 8)
         positions = jnp.full((eng.num_slots,), 8, jnp.int32)
-        hlo = eng.decode_step.lower(
+        step_args = (
             params, cache, host.device_table(), positions, tokens,
             active,
-        ).compile().as_text()
+        )
         n_donated = 2  # the paged cache donates {k, v}
     else:
-        hlo = eng.decode_step.lower(
-            params, cache, tokens, active
-        ).compile().as_text()
+        step_args = (params, cache, tokens, active)
         n_donated = 3  # {k, v, lengths}
+    hlo = eng.decode_step.lower(*step_args).compile().as_text()
     expected = (
         decode_ring_permutes(cfg.num_layers, s)
         if combo.collective_matmul else None
+    )
+    # Quantized-decode expectations (rule decode-quantized-matmul):
+    # trace-level dot records, since compiled CPU HLO normalizes the
+    # int8/bf16 dots back to f32. 4 opted-in projections per block,
+    # each lowering to S chunk dots under the rings (1 declaratively).
+    dot_records = (
+        jaxpr_dot_records(eng.decode_step, *step_args)
+        if combo.compute_dtype else ()
+    )
+    quant_dots = (
+        4 * cfg.num_layers * (s if combo.collective_matmul else 1)
+        if combo.compute_dtype else None
     )
     target = LintTarget(
         name=combo.name, engine="serve", donate=True, bf16=combo.bf16,
@@ -911,6 +1011,10 @@ def _build_serve(combo: Combo, devices):
         serve_decode_permutes=expected,
         # The decode step donates the cache leaves.
         n_param_leaves=n_donated,
+        compute_dtype=combo.compute_dtype,
+        decode_dot_records=dot_records,
+        quant_dot_count=quant_dots,
+        head_weight_shape=(cfg.dim, cfg.vocab_size),
         **_mesh_facts(mesh),
     )
     return target, hlo, mesh
@@ -996,6 +1100,22 @@ def full_matrix() -> List[Combo]:
                         collective_matmul=True))
     combos.append(Combo("serve", 4, page_size=8,
                         collective_matmul=True))
+    # Quantized decode compute (ISSUE 16, rule decode-quantized-
+    # matmul): int8/bf16 projection GEMMs on the declarative and
+    # opted-in-ring decode steps — the ring pin (serve-decode-ring)
+    # must stay CLEAN on the same combos, since only the chunk dot
+    # arithmetic changes; one paged+ring+int8 combo closes the
+    # paging x rings x quantization triangle. (serve/S2/cm/q-int8
+    # rides in via pregate_matrix().)
+    combos.append(Combo("serve", 2, compute_dtype="int8"))
+    combos.append(Combo("serve", 4, collective_matmul=True,
+                        compute_dtype="int8"))
+    combos.append(Combo("serve", 2, compute_dtype="bf16"))
+    combos.append(Combo("serve", 2, collective_matmul=True,
+                        compute_dtype="bf16"))
+    combos.append(Combo("serve", 2, page_size=8,
+                        collective_matmul=True,
+                        compute_dtype="int8"))
     combos += [Combo("pipeline", 2), Combo("pipeline", 4)]
     combos.append(Combo("tp", 4, collective_matmul=True, bf16=True))
     combos.append(Combo("sp", 4, collective_matmul=True, bf16=True))
@@ -1047,9 +1167,12 @@ def pregate_matrix() -> List[Combo]:
     overlapped — the deepest rule stack (rings + overlap deps + BN
     allowlist + at-rest) — plus one tinycnn-sized hierarchical MoE
     combo on a hybrid fabric, so a dispatch regression fails in seconds
-    with `moe-hierarchical-a2a` named, and one tinycnn-sized quantized
+    with `moe-hierarchical-a2a` named, one tinycnn-sized quantized
     hybrid combo so a broken wire codec fails with
-    `dcn-compressed-payload` named."""
+    `dcn-compressed-payload` named, and one quantized ringed serve
+    combo so a broken quantized decode path fails with
+    `decode-quantized-matmul` (or a broken ring with
+    `serve-decode-ring`) named."""
     return [
         Combo("ddp", 8, grad_reduction="overlapped", model="tinycnn"),
         Combo("fsdp", 8, grad_reduction="overlapped", model="tinycnn"),
@@ -1057,6 +1180,8 @@ def pregate_matrix() -> List[Combo]:
               moe_overlap=True),
         Combo("ddp", 4, grad_reduction="bucketed", dcn=2,
               dcn_compression="int8", model="tinycnn"),
+        Combo("serve", 2, collective_matmul=True,
+              compute_dtype="int8"),
     ]
 
 

@@ -127,9 +127,34 @@ class LintTarget:
     dcn_compression: str = "none"
     dcn_wire_chunks: Tuple[Tuple[int, str], ...] = ()
     dcn_wire_hops: Optional[int] = None
+    # ISSUE 16 satellite: the exact (n_elems, wire_dtype_token)
+    # multiset of FSDP's compressed WEIGHT-gather ring hops (the
+    # `fsdp_gather`-scoped dcn_wire records, kept separate from the
+    # gradient-bucket hops above) — (K-1) hops of full_leaf/K elems per
+    # dcn-crossing leaf per gather, x2 under "overlapped" (forward
+    # gather + backward regather).
+    dcn_gather_chunks: Tuple[Tuple[int, str], ...] = ()
     dcn_ring_records: Tuple[
         Tuple[Tuple[str, ...], str, str, int], ...
     ] = ()
+
+    # Quantized-decode expectations (`ops/quant_matmul.py`, rule
+    # `decode-quantized-matmul`). `decode_dot_records` is the
+    # traced-jaxpr record of EVERY `dot_general` equation in the decode
+    # step — ((lhs_dtype_token, rhs_dtype_token, rhs_shape), ...) —
+    # because compiled CPU HLO normalizes the quantized dots back to
+    # f32 (the bf16-ring-upcast precedent), so the compute-dtype
+    # contract lives at trace level. `quant_dot_count` is the exact
+    # quantized projection-dot count (4L per step declaratively, 4LS
+    # with the opted-in rings: S chunk dots per ring). The head matmul
+    # (`head_weight_shape`) deliberately stays f32 — logits feed
+    # sampling.
+    compute_dtype: Optional[str] = None
+    decode_dot_records: Tuple[
+        Tuple[str, str, Tuple[int, ...]], ...
+    ] = ()
+    quant_dot_count: Optional[int] = None
+    head_weight_shape: Optional[Tuple[int, ...]] = None
 
     # rule_id -> reason; the finding is reported but not counted
     # (module docstring).
@@ -609,6 +634,90 @@ def _serve_decode_ring(ctx: LintContext) -> List[Finding]:
     return out
 
 
+_QUANT_DOT_PAIR = {"int8": ("s8", "s8"), "bf16": ("bf16", "bf16")}
+
+
+@rule(
+    id="decode-quantized-matmul", severity="error", source="ISSUE 16",
+    contract=(
+        "An opted-in quantized decode step runs EVERY projection GEMM "
+        "in the declared arithmetic: exactly 4*layers quantized "
+        "dot_generals per step (4*layers*S with the opted-in rings — "
+        "S chunk dots per ring), ZERO f32 dot_generals on projection "
+        "shapes, and the head matmul still f32 (logits feed "
+        "sampling). Pinned from the traced jaxpr "
+        "(`lint.jaxpr_dot_records`): compiled CPU HLO normalizes "
+        "int8/bf16 dots back to f32, the bf16-ring-upcast precedent."
+    ),
+    applies=lambda t: (
+        t.engine == "serve" and t.compute_dtype is not None
+    ),
+)
+def _decode_quantized_matmul(ctx: LintContext) -> List[Finding]:
+    t = ctx.target
+    out = []
+    pair = _QUANT_DOT_PAIR.get(t.compute_dtype)
+    if pair is None:
+        return [ctx.finding(
+            "decode-quantized-matmul",
+            f"unknown compute_dtype {t.compute_dtype!r} — the "
+            "quantized-dot pin was not checked",
+        )]
+    if not t.decode_dot_records or t.quant_dot_count is None:
+        return [ctx.finding(
+            "decode-quantized-matmul",
+            "no decode_dot_records/quant_dot_count expectation on a "
+            "quantized serving combo — the compute-dtype pin was not "
+            "checked",
+        )]
+    # Projection dots are the rank-2-rhs dot_generals that are not the
+    # head matmul (attention's qk/av dots carry batched rank-3+ rhs).
+    quantized = [
+        r for r in t.decode_dot_records if (r[0], r[1]) == pair
+    ]
+    if len(quantized) != t.quant_dot_count:
+        out.append(ctx.finding(
+            "decode-quantized-matmul",
+            f"{len(quantized)} {t.compute_dtype} dot_generals in the "
+            f"decode trace, expected exactly {t.quant_dot_count} "
+            "(4 projections/block"
+            + (" x S chunk dots per ring" if t.collective_matmul
+               else "") + ")",
+        ))
+    f32_proj = [
+        r for r in t.decode_dot_records
+        if (r[0], r[1]) == ("f32", "f32") and len(r[2]) == 2
+        and r[2] != t.head_weight_shape
+    ]
+    for lhs, rhs, shape in f32_proj:
+        out.append(ctx.finding(
+            "decode-quantized-matmul",
+            f"f32 dot_general on projection shape {shape} in an "
+            f"opted-in {t.compute_dtype} decode step — the projection "
+            "fell back to f32 arithmetic",
+        ))
+    if t.head_weight_shape is not None:
+        head = [
+            r for r in t.decode_dot_records
+            if r[2] == t.head_weight_shape
+        ]
+        if not head:
+            out.append(ctx.finding(
+                "decode-quantized-matmul",
+                f"no dot_general on the head shape "
+                f"{t.head_weight_shape} — the head-matmul-stays-f32 "
+                "pin was not checked",
+            ))
+        for lhs, rhs, shape in head:
+            if (lhs, rhs) != ("f32", "f32"):
+                out.append(ctx.finding(
+                    "decode-quantized-matmul",
+                    f"head matmul {shape} traced {lhs}x{rhs}; the "
+                    "head stays f32 — logits feed sampling",
+                ))
+    return out
+
+
 # Named-scope exemption for bf16-ring-upcast: permutes whose trace
 # scope carries one of these names ride f32 ON PURPOSE and are not
 # upcast findings. `kv_ring` is ring attention's K/V rotation
@@ -743,8 +852,10 @@ def _scope_word(word: str, scope: str) -> bool:
         "the wire codec: each traced dcn-crossing ppermute is either a "
         "dcn_wire-scoped payload in the wire dtype (shape-pinned at "
         "1/2 resp. 1/4 the f32 bytes — the regrouped chunk's element "
-        "count at the wire itemsize) or, under int8, its one-scalar "
-        "f32 dcn_scale sidecar; and ZERO f32 grad- or dispatch-sized "
+        "count at the wire itemsize; FSDP's weight-gather ring hops "
+        "pin their own fsdp_gather multiset) or, under int8, its "
+        "one-scalar f32 dcn_scale sidecar; and ZERO f32 grad-, "
+        "weight- or dispatch-sized "
         "payload crosses 'dcn' in the compiled HLO (no non-scalar "
         "all-reduce outside the BN-state allowlist, no all-to-all, no "
         "all-gather/reduce-scatter). Checked from the traced jaxpr "
@@ -767,12 +878,19 @@ def _dcn_compressed_payload(ctx: LintContext) -> List[Finding]:
         return out
 
     payload: List[Tuple[int, str]] = []
+    gather_payload: List[Tuple[int, str]] = []
     sidecars: List[Tuple[str, int]] = []
     for axes, dt, scope, elems in t.dcn_ring_records:
         if t.dcn_axis not in axes:
             continue  # intra-slice / other-fabric traffic
         if _scope_word("dcn_wire", scope):
-            payload.append((elems, dt))
+            # FSDP's compressed weight-gather hops carry their own
+            # scope word so they pin against `dcn_gather_chunks`, not
+            # the gradient-bucket multiset (ISSUE 16 satellite).
+            if _scope_word("fsdp_gather", scope):
+                gather_payload.append((elems, dt))
+            else:
+                payload.append((elems, dt))
         elif _scope_word("dcn_scale", scope):
             sidecars.append((dt, elems))
         else:
@@ -816,8 +934,24 @@ def _dcn_compressed_payload(ctx: LintContext) -> List[Finding]:
             "compressed combo — the payload pin was not checked",
         ))
 
-    # Sidecar accounting: one f32 scalar per int8 payload hop, none
-    # otherwise.
+    # Weight-gather pin (ISSUE 16 satellite): FSDP's dcn gather leg
+    # rides the codec too — the fsdp_gather-scoped hops must match the
+    # builder's per-leaf ring-gather multiset exactly (both directions:
+    # an uncompressed fused gather shows up as a missing hop here AND
+    # as a monolithic dcn all-gather in the compiled-HLO half below).
+    expected_g = Counter(t.dcn_gather_chunks)
+    actual_g = Counter(gather_payload)
+    if actual_g != expected_g:
+        out.append(ctx.finding(
+            "dcn-compressed-payload",
+            f"fsdp_gather dcn_wire hops {dict(actual_g)} != expected "
+            f"compressed weight-gather chunks {dict(expected_g)} "
+            "(elems x wire-dtype per ring hop)",
+        ))
+
+    # Sidecar accounting: one f32 scalar per int8 payload hop (bucket
+    # AND gather hops), none otherwise.
+    n_coded = len(payload) + len(gather_payload)
     if t.dcn_compression == "int8":
         bad = [s for s in sidecars if s != ("f32", 1)]
         for dt, elems in bad:
@@ -826,11 +960,11 @@ def _dcn_compressed_payload(ctx: LintContext) -> List[Finding]:
                 f"dcn_scale sidecar is {elems} x {dt}, expected one "
                 "f32 scalar per hop",
             ))
-        if not bad and len(sidecars) != len(payload):
+        if not bad and len(sidecars) != n_coded:
             out.append(ctx.finding(
                 "dcn-compressed-payload",
                 f"{len(sidecars)} dcn_scale sidecars for "
-                f"{len(payload)} int8 payload hops — expected one per "
+                f"{n_coded} int8 payload hops — expected one per "
                 "hop",
             ))
     elif sidecars:
@@ -864,20 +998,22 @@ def _dcn_compressed_payload(ctx: LintContext) -> List[Finding]:
                 "payload on the slow fabric",
                 c.name,
             ))
-        # FSDP's per-leaf WEIGHT all-gathers legitimately cross 'dcn'
-        # (params live 1/N over the joint fabric — fetching them is not
-        # gradient traffic), so the gather ban covers the
-        # replicated-param engines only.
-        if t.engine in ("ddp", "sp_lm"):
-            for c in ctx.collectives:
-                if c.kind in ("all-gather", "reduce-scatter") \
-                        and c.crosses(t.dcn_axis):
-                    out.append(ctx.finding(
-                        "dcn-compressed-payload",
-                        f"{c.name}: monolithic {c.kind} crosses "
-                        f"'{t.dcn_axis}' on a compressed step",
-                        c.name,
-                    ))
+        # The gather ban covers all three reducer engines: ddp/sp_lm
+        # never legitimately gather across 'dcn', and FSDP's per-leaf
+        # weight all-gathers — which DO cross the joint fabric — ride
+        # the codec on an opted-in step since ISSUE 16
+        # (`parallel/fsdp._coded_dcn_gather`: ici-only all-gather +
+        # coded dcn ring), so a fused gather crossing 'dcn' here means
+        # a leaf fell off the compressed path.
+        for c in ctx.collectives:
+            if c.kind in ("all-gather", "reduce-scatter") \
+                    and c.crosses(t.dcn_axis):
+                out.append(ctx.finding(
+                    "dcn-compressed-payload",
+                    f"{c.name}: monolithic {c.kind} crosses "
+                    f"'{t.dcn_axis}' on a compressed step",
+                    c.name,
+                ))
     for c in ctx.collectives:
         if c.kind == "all-to-all" and c.crosses(t.dcn_axis):
             out.append(ctx.finding(

@@ -573,6 +573,20 @@ def check_serving_args(args) -> None:
             "'model' axis; it requires --layout tp with "
             "--model-shards >= 2"
         )
+    if getattr(args, "compute_dtype", "f32") != "f32":
+        if args.dtype != "float32":
+            raise SystemExit(
+                "--dtype and --compute-dtype both set the decode "
+                "arithmetic; --dtype bfloat16 is the legacy spelling "
+                "of --compute-dtype bf16 — pass only --compute-dtype"
+            )
+        if args.compute_dtype == "int8" and args.layout == "sp":
+            raise SystemExit(
+                "--compute-dtype int8 quantizes the decode projection "
+                "GEMMs (replicated/tp layouts); the sp layout's "
+                "shard_map decode has no quantized policy path — use "
+                "bf16 or a tp/replicated layout"
+            )
     # --- paged-cache knobs (serving/kv_cache.py) ---------------------
     if args.page_size < 0:
         raise SystemExit(
@@ -655,6 +669,17 @@ def compute_dtype_from_flag(name: str):
     import jax.numpy as jnp
 
     return {"float32": None, "bfloat16": jnp.bfloat16}[name]
+
+
+def serve_compute_dtype(args):
+    """--compute-dtype (preferred) / legacy --dtype -> ServingEngine
+    compute_dtype. `check_serving_args` has already rejected setting
+    both; the string triple passes through verbatim (the engine
+    normalizes via `ops/quant_matmul.normalize_compute_dtype`)."""
+    mode = getattr(args, "compute_dtype", "f32")
+    if mode != "f32":
+        return mode
+    return compute_dtype_from_flag(args.dtype)
 
 
 def add_common_tpu_flags(parser: argparse.ArgumentParser) -> None:

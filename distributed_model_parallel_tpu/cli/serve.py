@@ -30,7 +30,7 @@ import numpy as np
 from distributed_model_parallel_tpu.cli.common import (
     add_grad_reduction_flags,
     check_serving_args,
-    compute_dtype_from_flag,
+    serve_compute_dtype,
 )
 from distributed_model_parallel_tpu.models.gpt import GPTConfig
 from distributed_model_parallel_tpu.runtime.dist import initialize_backend
@@ -61,7 +61,19 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--ffn-dim", default=None, type=int,
                    help="default 4*dim")
     p.add_argument("--dtype", default="float32",
-                   choices=("float32", "bfloat16"))
+                   choices=("float32", "bfloat16"),
+                   help="legacy activation-dtype spelling; superseded "
+                        "by --compute-dtype (bfloat16 == bf16)")
+    p.add_argument("--compute-dtype", default="f32",
+                   choices=("f32", "bf16", "int8"),
+                   help="decode projection GEMM arithmetic "
+                        "(ops/quant_matmul.py): bf16 runs the MXU's "
+                        "native half path (activations + KV cache go "
+                        "bf16); int8 quantizes each decode projection "
+                        "with per-output-channel weight scales and "
+                        "per-token activation scales, accumulating in "
+                        "int32 and dequantizing on exit (activations "
+                        "and cache stay f32). Prefill always runs f32")
     # Serving surface.
     p.add_argument("--layout", default="replicated",
                    choices=("replicated", "tp", "sp"),
@@ -303,7 +315,7 @@ def main(argv=None) -> dict:
         max_len=args.max_len,
         prefill_len=args.prefill_len,
         collective_matmul=args.collective_matmul,
-        compute_dtype=compute_dtype_from_flag(args.dtype),
+        compute_dtype=serve_compute_dtype(args),
         page_size=args.page_size or None,
         num_pages=args.kv_pages or None,
         prefill_chunk=args.prefill_chunk or None,

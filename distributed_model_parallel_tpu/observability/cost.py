@@ -68,14 +68,39 @@ ALPHA_DCN_HOP_S = 10e-6
 # one element of a compressed cross-slice payload costs on the wire.
 WIRE_ITEMSIZE = {"none": 4, "f32": 4, "bf16": 2, "int8": 1}
 
+# Decode-compute roofline constants (ISSUE 16, `ops/quant_matmul.py`).
+# Public TPU v5e datasheet order of magnitude: ~819 GB/s HBM per chip
+# (conservative effective), 197 TFLOP/s bf16 MXU peak with int8 at 2x
+# and f32 at 1/4 of bf16 (the MXU's native half path).
+BW_HBM_EFFECTIVE = 800e9  # bytes/s effective weight-streaming bandwidth
+MXU_RATE = {  # flop/s (multiply-accumulate = 2 flop) per compute mode
+    "f32": 49.0e12,
+    "bf16": 197.0e12,
+    "int8": 394.0e12,
+}
+# What one weight element costs on the HBM stream per compute mode
+# (int8 streams quantized weights; the f32 scale sidecars are noise).
+COMPUTE_ITEMSIZE = {"f32": 4, "bf16": 2, "int8": 1}
+
 #: Every constant the predictions depend on, by name — recorded in the
 #: ledger so `tools/costgate` can refuse to compare predictions made
-#: under different physics.
+#: under different physics. CONSTANTS is the comm-fabric set the
+#: calibration machinery fits (`observability/calibrate.py`);
+#: COMPUTE_CONSTANTS is the decode-compute roofline set (hand-only —
+#: the CPU sandbox cannot measure MXU physics, so there is nothing to
+#: fit). The ledger records and drift-checks BOTH.
 CONSTANTS: Dict[str, float] = {
     "bw_ici_effective_bytes_per_s": BW_ICI_EFFECTIVE,
     "bw_dcn_effective_bytes_per_s": BW_DCN_EFFECTIVE,
     "alpha_hop_s": ALPHA_HOP_S,
     "alpha_dcn_hop_s": ALPHA_DCN_HOP_S,
+}
+
+COMPUTE_CONSTANTS: Dict[str, float] = {
+    "bw_hbm_effective_bytes_per_s": BW_HBM_EFFECTIVE,
+    "mxu_f32_flop_per_s": MXU_RATE["f32"],
+    "mxu_bf16_flop_per_s": MXU_RATE["bf16"],
+    "mxu_int8_flop_per_s": MXU_RATE["int8"],
 }
 
 
@@ -297,6 +322,88 @@ def serve_paged_request_s(live_tokens: int, prompt_tokens: int,
     return prefill + decode_writes + allocations
 
 
+def _resolve_compute_constants(
+    constants: Optional[Dict[str, float]],
+) -> Dict[str, float]:
+    """A full COMPUTE_CONSTANTS-shaped dict, validated, or the hand
+    block — the compute twin of `_resolve_constants` (the comm set and
+    the compute set are separate dicts because only the comm constants
+    are calibratable on this sandbox)."""
+    if constants is None:
+        return COMPUTE_CONSTANTS
+    missing = sorted(set(COMPUTE_CONSTANTS) - set(constants))
+    if missing:
+        raise ValueError(
+            f"compute constants set is missing {', '.join(missing)} — "
+            "pass a full COMPUTE_CONSTANTS-shaped dict"
+        )
+    return constants
+
+
+def quant_matmul_s(m: int, k: int, n: int, mode: str = "f32",
+                   constants: Optional[Dict[str, float]] = None,
+                   ) -> float:
+    """Roofline time of ONE decode projection GEMM x (k, n) in `mode`
+    arithmetic (`ops/quant_matmul.py`): max(weight-streaming HBM time,
+    MXU flop time). Decode's m is the slot batch — tiny — so the
+    k*n*itemsize weight stream dominates, which is exactly the term
+    quantization divides (int8 streams 1/4 the bytes of f32 AND runs
+    the MXU at 8x its f32 rate; the roofline picks whichever bound
+    still binds)."""
+    if mode not in MXU_RATE:
+        raise ValueError(
+            f"mode must be one of {sorted(MXU_RATE)}, got {mode!r}"
+        )
+    c = _resolve_compute_constants(constants)
+    hbm_s = k * n * COMPUTE_ITEMSIZE[mode] \
+        / c["bw_hbm_effective_bytes_per_s"]
+    mxu_s = 2.0 * m * k * n / c[f"mxu_{mode}_flop_per_s"]
+    return max(hbm_s, mxu_s)
+
+
+def serve_decode_compute_s(layers: int, dim: int, ffn_dim: int,
+                           n_slots: int, mode: str = "f32",
+                           shards: int = 1,
+                           constants: Optional[
+                               Dict[str, float]] = None) -> float:
+    """Per-decode-step projection-GEMM compute of the serving engine
+    (ISSUE 16): the 4 opted-in projections per block — qkv (dim ->
+    3*dim), attn-out (dim -> dim), ffn-in (dim -> ffn), ffn-out (ffn ->
+    dim) — times `layers`, each 1/shards per device under the tp
+    layout (Megatron column/row splits shard one weight dimension; the
+    ring and declarative lowerings stream the same per-device bytes).
+    The head matmul and attention dots deliberately stay f32 and are
+    mode-neutral, so they are not priced — this form exists to rank
+    compute modes, the same honesty note as every closed form here."""
+    projections = (
+        (dim, 3 * dim),      # fused qkv
+        (dim, dim),          # attention out
+        (dim, ffn_dim),      # ffn in
+        (ffn_dim, dim),      # ffn out
+    )
+    per_block = sum(
+        quant_matmul_s(n_slots, k, -(-n // shards), mode, constants)
+        for k, n in projections
+    )
+    return layers * per_block
+
+
+def serve_combo_compute_s(combo,
+                          constants: Optional[
+                              Dict[str, float]] = None) -> float:
+    """The decode-compute roofline of ONE lint-matrix serve combo.
+    Model facts mirror `lint._build_serve`'s proxy (GPT dim 16 / ffn 32
+    / 2 layers, 2*S slots over S 'model' shards) — shared by
+    `combo_cost` and the tuner's lowering tier
+    (`tuning/search.search_cell`) so the committed ledger and the
+    committed plans price the same form."""
+    return serve_decode_compute_s(
+        layers=2, dim=16, ffn_dim=32, n_slots=2 * combo.size,
+        mode=combo.compute_dtype or "f32", shards=combo.size,
+        constants=constants,
+    )
+
+
 # ------------------------------------------------------ the HLO walker
 
 
@@ -429,24 +536,52 @@ def combo_cost(combo, devices=None, constants=None) -> dict:
         fabrics=fabrics_from_constants(constants)
         if constants is not None else None,
     )
-    return breakdown.as_row()
+    row = breakdown.as_row()
+    if combo.engine == "serve":
+        row = add_serve_compute(row, combo)
+    return row
+
+
+def add_serve_compute(row: dict, combo,
+                      constants: Optional[
+                          Dict[str, float]] = None) -> dict:
+    """Fold the decode-compute roofline into one serve ledger row —
+    f32 combos too, so the cross-dtype deltas are visible in the
+    committed ledger (`decode_compute_s` carries the mode's own term;
+    `predicted_step_s` stays the single gated number)."""
+    compute_s = serve_combo_compute_s(combo, constants)
+    row = dict(row)
+    row["compute_dtype"] = combo.compute_dtype or "f32"
+    row["decode_compute_s"] = round(compute_s, 12)
+    row["predicted_step_s"] = round(
+        row["predicted_step_s"] + compute_s, 9
+    )
+    return row
 
 
 __all__ = [
     "ALPHA_DCN_HOP_S",
     "ALPHA_HOP_S",
     "BW_DCN_EFFECTIVE",
+    "BW_HBM_EFFECTIVE",
     "BW_ICI_EFFECTIVE",
+    "COMPUTE_CONSTANTS",
+    "COMPUTE_ITEMSIZE",
     "CONSTANTS",
     "CostBreakdown",
     "DCN",
     "Fabric",
     "ICI",
+    "MXU_RATE",
     "WIRE_ITEMSIZE",
+    "add_serve_compute",
     "combo_cost",
+    "serve_combo_compute_s",
     "fabrics_from_constants",
     "flat_all_to_all_s",
     "hierarchical_all_to_all_s",
+    "quant_matmul_s",
+    "serve_decode_compute_s",
     "serve_paged_request_s",
     "load_calibration",
     "predict_collectives",

@@ -43,7 +43,14 @@ import os
 import sys
 from typing import Dict, List, Optional, Sequence
 
-from distributed_model_parallel_tpu.observability.cost import CONSTANTS
+from distributed_model_parallel_tpu.observability.cost import (
+    COMPUTE_CONSTANTS,
+    CONSTANTS,
+)
+
+# Every ledger-recorded constant the drift guard compares: the comm
+# alpha/beta set plus the decode-compute roofline set (ISSUE 16).
+_ALL_CONSTANTS = {**CONSTANTS, **COMPUTE_CONSTANTS}
 
 DEFAULT_LEDGER = os.path.join(
     os.path.dirname(os.path.dirname(os.path.dirname(
@@ -69,7 +76,7 @@ def load_ledger(path: str) -> dict:
 def make_ledger(rows: Dict[str, dict],
                 tolerance: float = DEFAULT_TOLERANCE) -> dict:
     return {
-        "constants": dict(CONSTANTS),
+        "constants": dict(_ALL_CONSTANTS),
         "tolerance": tolerance,
         "combos": {k: rows[k] for k in sorted(rows)},
     }
@@ -90,7 +97,7 @@ def gate_check(
     tol = tolerance if tolerance is not None \
         else float(ledger.get("tolerance", DEFAULT_TOLERANCE))
     recorded = ledger.get("constants", {})
-    for key, want in CONSTANTS.items():
+    for key, want in _ALL_CONSTANTS.items():
         got = recorded.get(key)
         if got != want:
             failures.append(
@@ -309,7 +316,7 @@ def main(argv: Optional[List[str]] = None) -> int:
     old = load_ledger(args.ledger) if subset_update else None
     if old is not None:
         drifted = sorted(
-            k for k, v in CONSTANTS.items()
+            k for k, v in _ALL_CONSTANTS.items()
             if old.get("constants", {}).get(k) != v
         )
         if drifted:

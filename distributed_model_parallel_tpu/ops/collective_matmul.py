@@ -142,37 +142,57 @@ def _ring_fold(seed, axis_name, carry, fold):
 # --------------------------------------------------------------- forward
 
 
-def _ag_matmul_impl(x, w, axis_name):
-    """All-gather-then-matmul, gather decomposed into S-1 ppermutes."""
+def _ag_matmul_impl(x, w, axis_name, dot=None):
+    """All-gather-then-matmul, gather decomposed into S-1 ppermutes.
+
+    `dot` is the per-chunk GEMM seam (`ops/quant_matmul.quant_dot`):
+    None keeps the plain `chunk @ w` — byte-identical lowering — and an
+    injected dot changes ONLY the chunk arithmetic (bf16/int8 decode
+    projections); the ppermute schedule never sees it."""
+    if dot is None:
+        dot = lambda a, b: a @ b  # noqa: E731 - the identity seam
     size = _axis_size(axis_name)
     if size == 1:
-        return x @ w
+        return dot(x, w)
     i = lax.axis_index(axis_name)
     tl = x.shape[-2]
-    out = jnp.zeros(
-        (*x.shape[:-2], size * tl, w.shape[-1]), jnp.result_type(x, w)
-    )
+    # Output dtype follows the chunk dot (f32 for dequantized int8,
+    # bf16 for the cast path); eval_shape stays abstract, so no extra
+    # dot equation lands in the traced step.
+    out_dtype = jax.eval_shape(
+        dot,
+        jax.ShapeDtypeStruct(x.shape, x.dtype),
+        jax.ShapeDtypeStruct(w.shape, w.dtype),
+    ).dtype
+    out = jnp.zeros((*x.shape[:-2], size * tl, w.shape[-1]), out_dtype)
 
     def fold(buf, chunk, off):
         # The chunk originated at shard i+off; its rows belong at that
         # global offset.
         return lax.dynamic_update_slice_in_dim(
-            buf, chunk @ w, ((i + off) % size) * tl, axis=-2
+            buf, dot(chunk, w), ((i + off) % size) * tl, axis=-2
         )
 
     return _ring_fold(x, axis_name, out, fold)
 
 
-def _matmul_rs_impl(x, w, axis_name):
+def _matmul_rs_impl(x, w, axis_name, dot=None):
     """Matmul-then-reduce-scatter, scatter decomposed into S-1 ppermutes.
 
     Partial-sum accumulators travel the ring toward their destination
     shard; each device folds in its own partial dot for the chunk the
     arriving accumulator is destined for. The dots don't depend on the
-    permutes, so they fill the hop latency."""
+    permutes, so they fill the hop latency.
+
+    `dot` is the same per-chunk GEMM seam as `_ag_matmul_impl`; partial
+    sums accumulate in the dot's OUTPUT dtype (f32 for the dequantized
+    int8 path — the wire codec's decode-then-accumulate rule, applied
+    to the MXU)."""
+    if dot is None:
+        dot = lambda a, b: a @ b  # noqa: E731 - the identity seam
     size = _axis_size(axis_name)
     if size == 1:
-        return x @ w
+        return dot(x, w)
     i = lax.axis_index(axis_name)
     t = x.shape[-2]
     if t % size != 0:
@@ -184,7 +204,7 @@ def _matmul_rs_impl(x, w, axis_name):
 
     def pchunk(c):
         xc = lax.dynamic_slice_in_dim(x, (c % size) * tl, tl, axis=-2)
-        return xc @ w
+        return dot(xc, w)
 
     n_up, n_dn = _split(size)
     up, dn = _perms(size)
@@ -300,6 +320,23 @@ def _rs_bwd(axis_name, res, dy):
 
 
 matmul_rs.defvjp(_rs_fwd, _rs_bwd)
+
+
+def ag_matmul_quant(x, w, axis_name, dot):
+    """Inference-only `ag_matmul` with an injected per-chunk GEMM
+    (`ops/quant_matmul.quant_dot`): the ppermute chain is byte-identical
+    to the f32 ring — same hops, same payload dtype (the ring carries
+    ACTIVATION chunks, which stay in their math dtype) — only the chunk
+    dot changes arithmetic. No custom_vjp: the serving decode step that
+    consumes this never differentiates."""
+    return _ag_matmul_impl(x, w, axis_name, dot=dot)
+
+
+def matmul_rs_quant(x, w, axis_name, dot):
+    """Inference-only `matmul_rs` with an injected per-chunk GEMM;
+    partial sums ride (and accumulate in) the dot's dequantized output
+    dtype — see `_matmul_rs_impl`."""
+    return _matmul_rs_impl(x, w, axis_name, dot=dot)
 
 
 # ----------------------------------------------------- naive references
@@ -441,7 +478,9 @@ __all__ = [
     "CollectiveMatmul",
     "LocalCollectiveMatmul",
     "ag_matmul",
+    "ag_matmul_quant",
     "matmul_rs",
+    "matmul_rs_quant",
     "naive_ag_matmul",
     "naive_matmul_rs",
 ]

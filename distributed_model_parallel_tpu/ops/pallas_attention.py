@@ -536,12 +536,20 @@ def flash_attention(
 
     `interpret=None` auto-selects: compiled on TPU, interpreter
     elsewhere (tests). See module docstring for scope.
+
+    Availability is probed ONCE at import (`_VMEM`, module top): on a
+    build without `jax.experimental.pallas.tpu` the call degrades to
+    the dense `dot_product_attention` reference instead of raising —
+    the same probe-at-import / fall-back-at-call shape as
+    `ops/quant_matmul.quant_matmul`, so a serving or training step
+    composed against `flash_attention` stays runnable (slower, denser)
+    on exotic builds rather than failing mid-request (ISSUE 16
+    satellite; the old call-time RuntimeError turned a missing
+    OPTIONAL dependency into a hard fault).
     """
     if _VMEM is None:
-        raise RuntimeError(
-            "flash_attention needs jax.experimental.pallas.tpu, which "
-            "failed to import in this environment; use "
-            "ops.attention.dot_product_attention instead"
+        return dot_product_attention(
+            q, k, v, mask, scale=scale, causal=causal
         )
     if interpret is None:
         interpret = jax.default_backend() != "tpu"

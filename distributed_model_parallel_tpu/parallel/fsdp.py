@@ -70,6 +70,7 @@ from distributed_model_parallel_tpu.ops.grad_reduction import (
 )
 from distributed_model_parallel_tpu.ops.wire_codec import (
     check_compression,
+    coded_ppermute,
     require_dcn_axis,
 )
 from distributed_model_parallel_tpu.parallel.data_parallel import (
@@ -131,6 +132,47 @@ def _sharded_dim(spec: P):
     return None, None
 
 
+# The weight-gather scope word: hlolint's `dcn-compressed-payload` rule
+# separates these ring hops (tag/dcn_wire nested scopes) from the
+# gradient-bucket hops when it pins the compressed-gather multiset.
+GATHER_SCOPE = "fsdp_gather"
+
+
+def _coded_dcn_gather(leaf, d, ici_axis, dcn_axis, dcn_k, wire):
+    """The monolithic `all_gather(('dcn', 'ici'))` of one sharded leaf,
+    decomposed so only the intra-slice leg stays f32: an uncompressed
+    all-gather over 'ici' materializes this slice's block (1/K of the
+    full leaf), then K-1 `coded_ppermute` hops rotate the blocks around
+    the 'dcn' ring in the wire dtype, each received block placed at its
+    SOURCE slice's offset — reproducing the dcn-major tiled layout of
+    the fused gather exactly, so `slice_tree`'s `data_replica_index`
+    arithmetic and the at-rest 1/N checkpoints are unchanged. Same
+    cross-slice bytes as the fused gather's dcn leg ((K-1)/K of the
+    leaf) at 1/2 resp. 1/4 the f32 wire bytes; a block reaching slice
+    j+s has crossed the codec s times, but re-encoding a just-decoded
+    block is idempotent up to the one-ULP scale drift, so the error
+    budget stays the single-hop bound the parity tests pin."""
+    block = lax.all_gather(leaf, ici_axis, axis=d, tiled=True)
+    if dcn_k <= 1:
+        return block
+    n = block.shape[d]
+    full = jnp.zeros(
+        block.shape[:d] + (n * dcn_k,) + block.shape[d + 1:],
+        block.dtype,
+    )
+    j = lax.axis_index(dcn_axis)
+    full = lax.dynamic_update_slice_in_dim(full, block, j * n, axis=d)
+    perm = tuple((i, (i + 1) % dcn_k) for i in range(dcn_k))
+    cur = block
+    for s in range(1, dcn_k):
+        cur = coded_ppermute(cur, dcn_axis, perm, wire, GATHER_SCOPE)
+        src = (j - s) % dcn_k
+        full = lax.dynamic_update_slice_in_dim(
+            full, cur, src * n, axis=d
+        )
+    return full
+
+
 @dataclasses.dataclass
 class FSDPEngine(TensorParallelEngine):
     """GSPMD fully-sharded data parallelism: batch AND parameters (and
@@ -163,9 +205,11 @@ class FSDPEngine(TensorParallelEngine):
     # Backward segment count under "overlapped" (0 = auto: min(4, number
     # of model blocks)).
     overlap_stages: int = 0
-    # Compress the cross-slice 'dcn' hop of each bucket's reduction to
-    # this wire dtype ("none" | "bf16" | "int8", `ops/wire_codec.py`) —
-    # see DDPEngine.dcn_compression. Requires a MeshSpec(dcn=K) mesh.
+    # Compress the cross-slice 'dcn' hop of each bucket's reduction —
+    # AND of each sharded leaf's weight all-gather (`_coded_dcn_gather`:
+    # ici gather + coded dcn ring, ISSUE 16) — to this wire dtype
+    # ("none" | "bf16" | "int8", `ops/wire_codec.py`); see
+    # DDPEngine.dcn_compression. Requires a MeshSpec(dcn=K) mesh.
     # Under grad_reduction="monolithic" the declarative jit step has no
     # explicit dcn seam, so compression selects the EXPLICIT shard_map
     # step with one flat bucket per dtype (same at-rest 1/N layout,
@@ -267,14 +311,25 @@ class FSDPEngine(TensorParallelEngine):
         )
         self._state_pspecs = state_specs
 
+        dcn_k = int(mesh.shape[dcn_axis]) if dcn_axis else 1
+
         def gather_tree(tree, specs):
             """Per-leaf weight all-gather: the ZeRO-3 'materialize right
-            before use' collective, explicit."""
+            before use' collective, explicit. With a compressed wire the
+            cross-slice leg of each dcn-crossing leaf rides the codec
+            (`_coded_dcn_gather`) — weight fetch is the OTHER large
+            payload on the slow fabric, and it compresses at the same
+            seam as the gradient buckets."""
 
             def gather(leaf, spec):
                 d, axes = _sharded_dim(spec)
                 if d is None:
                     return leaf
+                ax = axes if isinstance(axes, tuple) else (axes,)
+                if wire != "none" and dcn_axis in ax:
+                    return _coded_dcn_gather(
+                        leaf, d, ici_axis, dcn_axis, dcn_k, wire
+                    )
                 return lax.all_gather(leaf, axes, axis=d, tiled=True)
 
             return jax.tree_util.tree_map(gather, tree, specs)

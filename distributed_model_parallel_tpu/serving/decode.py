@@ -57,8 +57,11 @@ from distributed_model_parallel_tpu.ops.attention import (
 )
 from distributed_model_parallel_tpu.ops.collective_matmul import (
     ag_matmul,
+    ag_matmul_quant,
     matmul_rs,
+    matmul_rs_quant,
 )
+from distributed_model_parallel_tpu.ops.quant_matmul import quant_dot
 from distributed_model_parallel_tpu.runtime.compat import shard_map
 
 
@@ -500,12 +503,20 @@ class DecodeCollectiveMatmul:
     activations sit exactly where the declarative TP layout puts them
     (head/feature-sharded), so the cache attention is untouched; the
     residual stream between blocks rides slot-sharded over `axis` —
-    the decode analog of the Megatron-SP layout."""
+    the decode analog of the Megatron-SP layout.
+
+    `compute_dtype` ("bf16" | "int8" | None) injects a quantized
+    per-chunk GEMM into the fold bodies (`ops/quant_matmul.quant_dot`):
+    the ring permute chain stays byte-identical — same hops, same
+    payload dtype, `serve-decode-ring` still pins 4·L·(S-1) — and only
+    the chunk dot arithmetic changes (`decode-quantized-matmul` pins
+    the chunk-dot dtypes from the jaxpr)."""
 
     mesh: Mesh
     axis: str = "model"
     attn: bool = True
     ffn: bool = True
+    compute_dtype: Optional[str] = None
 
     def _check(self, rows: int, features: int, fdim: str) -> None:
         size = self.mesh.shape[self.axis]
@@ -527,7 +538,10 @@ class DecodeCollectiveMatmul:
         slots = h.shape[0]
         self._check(slots, w.shape[-1], "output features")
         fn = shard_map(
-            partial(_decode_column, axis_name=self.axis),
+            partial(
+                _decode_column, axis_name=self.axis,
+                mode=self.compute_dtype,
+            ),
             mesh=self.mesh,
             in_specs=(P(self.axis, None), P(None, self.axis),
                       P(self.axis)),
@@ -547,7 +561,10 @@ class DecodeCollectiveMatmul:
         slots = h.shape[0]
         self._check(slots, w.shape[0], "input features")
         fn = shard_map(
-            partial(_decode_row, axis_name=self.axis),
+            partial(
+                _decode_row, axis_name=self.axis,
+                mode=self.compute_dtype,
+            ),
             mesh=self.mesh,
             in_specs=(P(None, self.axis), P(self.axis, None), P()),
             out_specs=P(self.axis, None),
@@ -558,12 +575,20 @@ class DecodeCollectiveMatmul:
         return y[:, None, :]
 
 
-def _decode_column(hl, wl, bl, *, axis_name):
-    return ag_matmul(hl, wl, axis_name) + bl
+def _decode_column(hl, wl, bl, *, axis_name, mode=None):
+    dot = quant_dot(mode)
+    if dot is None:
+        return ag_matmul(hl, wl, axis_name) + bl
+    y = ag_matmul_quant(hl, wl, axis_name, dot)
+    return y + bl.astype(y.dtype)
 
 
-def _decode_row(hl, wl, b, *, axis_name):
-    return matmul_rs(hl, wl, axis_name) + b
+def _decode_row(hl, wl, b, *, axis_name, mode=None):
+    dot = quant_dot(mode)
+    if dot is None:
+        return matmul_rs(hl, wl, axis_name) + b
+    y = matmul_rs_quant(hl, wl, axis_name, dot)
+    return y + b.astype(y.dtype)
 
 
 def decode_ring_permutes(num_layers: int, size: int) -> int:

@@ -59,6 +59,10 @@ from distributed_model_parallel_tpu.observability.trace import get_tracer
 from distributed_model_parallel_tpu.ops.attention import (
     dot_product_attention,
 )
+from distributed_model_parallel_tpu.ops.quant_matmul import (
+    QuantMatmul,
+    normalize_compute_dtype,
+)
 from distributed_model_parallel_tpu.ops.ring_attention import (
     ring_attention,
 )
@@ -110,7 +114,13 @@ class ServingEngine:
     # Latency-hiding decode rings over 'model' (tp layout only):
     # `serving/decode.DecodeCollectiveMatmul`. Default off, same math.
     collective_matmul: bool = False
-    compute_dtype: Any = None  # activation dtype; None = f32
+    # Decode-projection compute dtype: "f32" (default), "bf16"
+    # (half-precision activations + cache, the MXU's native half path),
+    # or "int8" (absmax-quantized projection GEMMs on the decode hot
+    # floor — `ops/quant_matmul.py`; activations/cache stay f32, only
+    # the opted-in projection dots quantize, prefill untouched). A
+    # dtype object (jnp.bfloat16) is accepted for back-compat.
+    compute_dtype: Any = None
     donate: bool = True  # donate the cache buffers step-over-step
     # --- block paging (PagedAttention; serving/kv_cache.py) ----------
     # page_size None = the contiguous slot layout above; set = the
@@ -150,7 +160,22 @@ class ServingEngine:
             raise ValueError(
                 f"dim {cfg.dim} not divisible by heads {cfg.num_heads}"
             )
-        cache_dtype = self.compute_dtype or jnp.float32
+        # Normalize the knob once: the string triple {"f32","bf16",
+        # "int8"} is the engine/CLI surface; dtype objects map onto it.
+        self.compute_mode = normalize_compute_dtype(self.compute_dtype)
+        # Activation/cache dtype. int8 keeps BOTH f32: quantization
+        # lives inside the projection GEMMs (per-token dynamic scales,
+        # dequantized f32 out — ops/quant_matmul.py), never at rest.
+        self._act_dtype = (
+            jnp.bfloat16 if self.compute_mode == "bf16" else None
+        )
+        if self.compute_mode == "int8" and self.layout == "sp":
+            raise ValueError(
+                "compute_dtype='int8' quantizes the decode projections "
+                "(replicated/tp layouts); the sp layout's shard_map "
+                "decode has no quantized policy path"
+            )
+        cache_dtype = self._act_dtype or jnp.float32
         self.spec = KVCacheSpec(
             num_layers=cfg.num_layers, num_slots=self.num_slots,
             max_len=self.max_len, num_heads=cfg.num_heads,
@@ -252,8 +277,19 @@ class ServingEngine:
                             "axis"
                         )
                 self._mm = DecodeCollectiveMatmul(
-                    mesh=self.mesh, axis="model"
+                    mesh=self.mesh, axis="model",
+                    compute_dtype=(
+                        "int8" if self.compute_mode == "int8" else None
+                    ),
                 )
+        # The decode-step projection policy: the opted-in rings when
+        # built above; otherwise, under int8, the non-ring quantized
+        # policy (replicated / tp-without-rings — GSPMD partitions the
+        # int8 dots). Threaded ONLY into the decode steps — prefill
+        # stays f32 (the decode hot floor is the target).
+        self._decode_mm = self._mm
+        if self.compute_mode == "int8" and self._mm is None:
+            self._decode_mm = QuantMatmul()
         if self.layout == "sp":
             s = self.mesh.shape["seq"]
             if self.prefill_len % s:
@@ -305,12 +341,12 @@ class ServingEngine:
 
     def _build_steps(self):
         cfg = self.cfg
-        cdt = self.compute_dtype
+        cdt = self._act_dtype
         num_slots = self.num_slots
         max_len = self.max_len
         p_len = self.prefill_len
         blocks_state = self._blocks_state
-        mm = self._mm
+        mm = self._decode_mm
         ctx = L.Context(train=False, dtype=cdt)
 
         def run_blocks(params, x, attention_fn, block_ctx):

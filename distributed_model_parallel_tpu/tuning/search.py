@@ -78,6 +78,10 @@ def cell_payload(cell: Cell) -> dict:
             "prompt_tokens": 8,
             "new_tokens": 8,
             "token_bytes": token_bytes,
+            # For the compute-dtype roofline term (ISSUE 16): the
+            # proxy's slot batch and 'model' shard count.
+            "n_slots": 2 * cell.size,
+            "shards": cell.size,
         }
     import jax
     import jax.numpy as jnp
@@ -198,15 +202,24 @@ def serve_closed_form_s(knobs: dict, payload: dict,
     """Predicted per-request serving cost for one paged-cache
     candidate — `cost.serve_paged_request_s` over the lint serve
     proxy's payload (the page-overscan vs gather-launch and
-    chunk-padding vs chunk-launch tradeoffs, ISSUE 15)."""
+    chunk-padding vs chunk-launch tradeoffs, ISSUE 15) plus the
+    compute-dtype roofline term over the request's decode steps
+    (ISSUE 16; priced under the hand MXU/HBM constants — the comm
+    `constants` dict is the calibratable set, compute is not)."""
     from distributed_model_parallel_tpu.observability import cost
 
-    return cost.serve_paged_request_s(
+    comm = cost.serve_paged_request_s(
         payload["live_tokens"], payload["prompt_tokens"],
         payload["new_tokens"], payload["token_bytes"],
         knobs["page_size"], knobs["prefill_chunk"],
         constants=constants,
     )
+    compute = payload["new_tokens"] * cost.serve_decode_compute_s(
+        layers=2, dim=16, ffn_dim=32, n_slots=payload["n_slots"],
+        mode=knobs.get("compute_dtype") or "f32",
+        shards=payload.get("shards", 1),
+    )
+    return comm + compute
 
 
 def closed_form_step_s(family: str, knobs: dict, payload: dict,
@@ -292,11 +305,14 @@ def candidate_combo(cell: Cell, knobs: dict):
         # The paged decode step lowers per page_size; prefill_chunk
         # shapes the HOST loop only (no compiled-step difference), so
         # it rides the combo name for plan identity and the closed
-        # form decides it.
+        # form decides it. compute_dtype "f32" maps to the Combo
+        # sentinel None (pre-ISSUE-16 names stay byte-stable).
+        mode = knobs.get("compute_dtype") or "f32"
         return Combo(
             "serve", cell.size,
             page_size=knobs["page_size"],
             prefill_chunk=knobs["prefill_chunk"],
+            compute_dtype=None if mode == "f32" else mode,
         )
     raise ValueError(f"no combo mapping for family {cell.family!r}")
 
@@ -377,6 +393,14 @@ def search_cell(cell: Cell,
             combo, devices, constants
         )
         row = breakdown.as_row()
+        if cell.family == "serve":
+            # Same compute-roofline fold as the costgate ledger
+            # (`cost.add_serve_compute`) — the plan's gated number and
+            # the ledger's price the same form.
+            from distributed_model_parallel_tpu.observability.cost \
+                import add_serve_compute
+
+            row = add_serve_compute(row, combo)
         say(f"[tuning]   {combo.name}: closed-form "
             f"{closed_s * 1e3:.4f} ms -> lowered "
             f"{row['predicted_step_s'] * 1e3:.4f} ms/step")

@@ -86,10 +86,15 @@ SPACES: Dict[str, Tuple[Knob, ...]] = {
     # alongside parallel/). Values sized to divide the lint serve
     # proxy's 16-position cache — the same proxy-fits-the-grid
     # compromise as _BUCKET_GRID's sub-MB values.
+    # compute_dtype (ISSUE 16): the decode projection GEMM arithmetic
+    # (`ops/quant_matmul.py`), priced by the MXU/HBM roofline closed
+    # form (`cost.serve_decode_compute_s`).
     "serve": (
         Knob("page_size", (4, 8, 16), "--page-size", "page_size"),
         Knob("prefill_chunk", (4, 8, 16), "--prefill-chunk",
              "prefill_chunk"),
+        Knob("compute_dtype", ("f32", "bf16", "int8"),
+             "--compute-dtype", "compute_dtype"),
     ),
 }
 
@@ -155,8 +160,15 @@ def preference(family: str, knobs: dict) -> tuple:
         )
     if family == "serve":
         # Equal-cost ties break toward less HBM overscan (smaller
-        # pages), then fewer ingest launches (larger chunks).
-        return (knobs["page_size"], -knobs["prefill_chunk"])
+        # pages), then fewer ingest launches (larger chunks), then the
+        # LESS exotic arithmetic (quantization the roofline doesn't
+        # pay for is free numerics risk — mirrors the wire tie-break).
+        return (
+            knobs["page_size"], -knobs["prefill_chunk"],
+            ("f32", "bf16", "int8").index(
+                knobs.get("compute_dtype") or "f32"
+            ),
+        )
     # tp: prefer the ring decomposition on a tie (latency hiding).
     return (0 if knobs["collective_matmul"] else 1,)
 

@@ -753,6 +753,120 @@ def test_dcn_compressed_hop_count_pin_for_moe():
     assert "all-to-all crosses 'dcn'" in msgs
 
 
+def test_dcn_compressed_fsdp_gather_pin():
+    """The FSDP half of the pin (ISSUE 16 satellite): the weight
+    gather's dcn leg must appear as fsdp_gather-scoped coded ring hops
+    matching the builder's multiset, and a fused all-gather crossing
+    'dcn' is contraband on the compressed step (a leaf that fell off
+    `_coded_dcn_gather`)."""
+    fsdp = compressed_target(
+        engine="fsdp", grad_reduction="monolithic",
+        dcn_gather_chunks=((32, "s8"), (32, "s8")),
+        dcn_ring_records=compressed_target().dcn_ring_records + (
+            (("dcn",), "s8", "jit(f)/fsdp_gather/dcn_wire", 32),
+            (("dcn",), "f32", "jit(f)/fsdp_gather/dcn_scale", 1),
+            (("dcn",), "s8", "jit(f)/fsdp_gather/dcn_wire", 32),
+            (("dcn",), "f32", "jit(f)/fsdp_gather/dcn_scale", 1),
+        ),
+    )
+    assert check(
+        "dcn-compressed-payload", fsdp, module([]), MESH_2x4
+    ) == []
+    # Gather hops missing from the trace + a surviving fused gather
+    # over 'dcn' in the compiled HLO: both halves fire.
+    import dataclasses
+
+    bad = check(
+        "dcn-compressed-payload",
+        dataclasses.replace(
+            fsdp, dcn_ring_records=compressed_target().dcn_ring_records,
+        ),
+        module([
+            "%ag = f32[128]{0} all-gather(f32[64]{0} %p), "
+            "replica_groups=" + DCN_GROUPS + ", dimensions={0}, "
+            "use_global_device_ids=true",
+        ]),
+        MESH_2x4,
+    )
+    msgs = " | ".join(f.message for f in bad)
+    assert "expected compressed weight-gather chunks" in msgs
+    assert "monolithic all-gather crosses 'dcn'" in msgs
+
+
+# ------------------------------------------------ decode-quantized-matmul
+
+
+_QUANT_DOTS = tuple(("s8", "s8", (16, 48)) for _ in range(8))
+
+
+def quant_serve_target(**kw):
+    """Quantized serve decode on a single-host trace: 8 int8 projection
+    dots (4 per layer x 2 layers), the f32 head, and one batched
+    attention dot (rank-4 rhs — never counted as a projection)."""
+    base = dict(
+        name="t", engine="serve",
+        data_axes=(), ici_axis=None, ici_size=1,
+        compute_dtype="int8", quant_dot_count=8,
+        head_weight_shape=(16, 61),
+        decode_dot_records=_QUANT_DOTS + (
+            ("f32", "f32", (16, 61)),
+            ("f32", "f32", (2, 4, 16, 4)),
+        ),
+    )
+    base.update(kw)
+    return LintTarget(**base)
+
+
+@pytest.mark.hlo_rule("decode-quantized-matmul", "positive")
+def test_decode_quantized_fires_on_f32_projection_and_quantized_head():
+    # 6 of 8 projections quantized, one fell back to f32, and the head
+    # got quantized: the count pin, the zero-f32-projection pin and the
+    # head-stays-f32 pin all fire.
+    found = check(
+        "decode-quantized-matmul",
+        quant_serve_target(decode_dot_records=_QUANT_DOTS[:6] + (
+            ("f32", "f32", (16, 48)),
+            ("s8", "s8", (16, 61)),
+        )),
+        module([]), MESH_M4,
+    )
+    msgs = " | ".join(f.message for f in found)
+    assert "expected exactly 8" in msgs
+    assert "fell back to f32 arithmetic" in msgs
+    assert "head stays f32" in msgs
+
+
+@pytest.mark.hlo_rule("decode-quantized-matmul", "negative")
+def test_decode_quantized_pinned_trace_is_clean():
+    assert check(
+        "decode-quantized-matmul", quant_serve_target(), module([]),
+        MESH_M4,
+    ) == []
+
+
+def test_decode_quantized_missing_records_is_a_finding():
+    """A quantized combo whose builder collected no dot records must
+    surface, not silently pass."""
+    found = check(
+        "decode-quantized-matmul",
+        quant_serve_target(decode_dot_records=(), quant_dot_count=None),
+        module([]), MESH_M4,
+    )
+    assert found and "was not checked" in found[0].message
+
+
+def test_decode_quantized_missing_head_record_is_a_finding():
+    found = check(
+        "decode-quantized-matmul",
+        quant_serve_target(decode_dot_records=_QUANT_DOTS),
+        module([]), MESH_M4,
+    )
+    assert found and any(
+        "head-matmul-stays-f32 pin was not checked" in f.message
+        for f in found
+    )
+
+
 # ------------------------------------------------- donated-step-aliased
 
 

@@ -52,6 +52,10 @@ TIER1_COMBOS = [
           dcn_compression="int8", model="tinycnn"),
     Combo("ep", 4, dcn=2, moe_dispatch="hierarchical",
           dcn_compression="bf16"),
+    # quantized decode floor (decode-quantized-matmul): every decode
+    # projection dot is s8 x s8 inside the cm rings, head stays f32
+    # (the pre-gate twin)
+    Combo("serve", 2, collective_matmul=True, compute_dtype="int8"),
 ]
 
 

@@ -92,7 +92,7 @@ def build_golden_ledger() -> dict:
     whose predicted step time equals the golden trace's measured
     per-step sync (3 ms), under the CURRENT constants."""
     return {
-        "constants": dict(cost.CONSTANTS),
+        "constants": {**cost.CONSTANTS, **cost.COMPUTE_CONSTANTS},
         "tolerance": 0.05,
         "combos": {"golden/S2": {
             "predicted_step_s": 0.003,

@@ -190,6 +190,33 @@ def test_prime_length_falls_back_to_xla_path():
     )
 
 
+def test_missing_pallas_tpu_engages_dense_fallback(monkeypatch):
+    """ISSUE 16 satellite: on a build where the module-level
+    `pallas.tpu` probe failed (`_VMEM is None`), `flash_attention`
+    degrades to the dense `dot_product_attention` reference — bit-equal
+    output, no call-time RuntimeError (the probe-at-import /
+    fall-back-at-call shape shared with `ops/quant_matmul`)."""
+    from distributed_model_parallel_tpu.ops import pallas_attention as pa
+
+    q, k, v, mask = _qkv(seed=21, t=64)
+    monkeypatch.setattr(pa, "_VMEM", None)
+    monkeypatch.setattr(pa, "pltpu", None)
+    got = pa.flash_attention(q, k, v, mask, causal=True)
+    want = dot_product_attention(q, k, v, mask, causal=True)
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+    # Gradients flow through the fallback too (it is the reference
+    # implementation, not a stub).
+    g = jax.grad(
+        lambda k: jnp.sum(pa.flash_attention(q, k, v) ** 2)
+    )(k)
+    gref = jax.grad(
+        lambda k: jnp.sum(dot_product_attention(q, k, v) ** 2)
+    )(k)
+    np.testing.assert_allclose(
+        np.asarray(g), np.asarray(gref), rtol=1e-6, atol=1e-6
+    )
+
+
 def test_flash_dh128_matches_xla():
     """dh=128 (the transformer-base head dim, and the MXU-width lane
     count) through the fused kernels — forward and gradients — matches

@@ -1,0 +1,219 @@
+"""Quantized decode-floor matmuls (`ops/quant_matmul.py`, ISSUE 16):
+the scale-layout contract (per-output-channel weights, per-token
+dynamic activations), the int8 error bound against the f32 reference,
+path parity (Pallas-interpret kernel vs the dtype-pinned XLA
+fallback), the jaxpr dtype records hlolint's `decode-quantized-matmul`
+rule pins, and the mode/selector surfaces the engine threads."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from distributed_model_parallel_tpu.ops.quant_matmul import (
+    COMPUTE_DTYPES,
+    QuantMatmul,
+    check_compute_dtype,
+    normalize_compute_dtype,
+    quant_dot,
+    quant_matmul,
+    quantize_rows,
+    quantize_weight,
+)
+from distributed_model_parallel_tpu.ops.wire_codec import ABSMAX_FLOOR
+
+
+def _xw(seed=0, m=8, k=32, n=48):
+    rng = np.random.RandomState(seed)
+    x = jnp.asarray(rng.randn(m, k).astype(np.float32))
+    w = jnp.asarray(rng.randn(k, n).astype(np.float32))
+    return x, w
+
+
+# ------------------------------------------------------------- surface
+
+
+def test_compute_dtype_surface():
+    assert COMPUTE_DTYPES == ("f32", "bf16", "int8")
+    for mode in COMPUTE_DTYPES:
+        assert check_compute_dtype(mode) == mode
+        assert normalize_compute_dtype(mode) == mode
+    assert normalize_compute_dtype(None) == "f32"
+    assert normalize_compute_dtype(jnp.bfloat16) == "bf16"
+    assert normalize_compute_dtype(jnp.float32) == "f32"
+    with pytest.raises(ValueError, match="compute_dtype"):
+        check_compute_dtype("fp8")
+    with pytest.raises(ValueError, match="compute_dtype"):
+        normalize_compute_dtype(jnp.float16)
+    with pytest.raises(ValueError, match="compute_dtype"):
+        normalize_compute_dtype(object())
+
+
+def test_rejects_bad_mode_and_path():
+    x, w = _xw()
+    with pytest.raises(ValueError, match="compute_dtype"):
+        quant_matmul(x, w, "fp4")
+    with pytest.raises(ValueError, match="path"):
+        quant_matmul(x, w, "int8", path="cuda")
+
+
+# ------------------------------------------------------- scale layout
+
+
+def test_quantize_weight_per_output_channel():
+    _, w = _xw(seed=1)
+    wq, scale = quantize_weight(w)
+    assert wq.dtype == jnp.int8 and wq.shape == w.shape
+    assert scale.dtype == jnp.float32 and scale.shape == (w.shape[1],)
+    np.testing.assert_allclose(
+        np.asarray(scale),
+        np.abs(np.asarray(w)).max(axis=0) / 127.0,
+        rtol=1e-6,
+    )
+    # Elementwise decode bound: absmax/254 per column (module contract).
+    err = np.abs(
+        np.asarray(wq).astype(np.float32) * np.asarray(scale)[None, :]
+        - np.asarray(w)
+    )
+    bound = np.abs(np.asarray(w)).max(axis=0) / 254.0
+    assert (err <= bound[None, :] + 1e-7).all()
+
+
+def test_quantize_weight_zero_column_decodes_exact_zero():
+    w = jnp.zeros((16, 4), jnp.float32)
+    wq, scale = quantize_weight(w)
+    assert (np.asarray(wq) == 0).all()
+    # The floored scale stays NORMAL (the wire codec's denormal guard:
+    # a denormal scale would flush to zero under FTZ).
+    assert (np.asarray(scale) >= np.finfo(np.float32).tiny).all()
+    assert (
+        np.asarray(wq).astype(np.float32) * np.asarray(scale) == 0
+    ).all()
+
+
+def test_quantize_rows_per_token():
+    x, _ = _xw(seed=2)
+    q, scale = quantize_rows(x)
+    assert q.dtype == jnp.int8 and q.shape == x.shape
+    assert scale.shape == (x.shape[0], 1)
+    np.testing.assert_allclose(
+        np.asarray(scale)[:, 0],
+        np.abs(np.asarray(x)).max(axis=-1) / 127.0,
+        rtol=1e-6,
+    )
+    err = np.abs(
+        np.asarray(q).astype(np.float32) * np.asarray(scale)
+        - np.asarray(x)
+    )
+    bound = np.abs(np.asarray(x)).max(axis=-1, keepdims=True) / 254.0
+    assert (err <= bound + 1e-7).all()
+
+
+# ------------------------------------------------------------ the GEMM
+
+
+def test_f32_mode_is_the_identity_dot():
+    x, w = _xw(seed=3)
+    np.testing.assert_array_equal(
+        np.asarray(quant_matmul(x, w, "f32")), np.asarray(x @ w)
+    )
+
+
+def test_bf16_mode_casts_both_operands():
+    x, w = _xw(seed=4)
+    y = quant_matmul(x, w, "bf16")
+    assert y.dtype == jnp.bfloat16
+    np.testing.assert_array_equal(
+        np.asarray(y),
+        np.asarray(x.astype(jnp.bfloat16) @ w.astype(jnp.bfloat16)),
+    )
+    rel = np.abs(
+        np.asarray(y, np.float32) - np.asarray(x @ w)
+    ).max() / np.abs(np.asarray(x @ w)).max()
+    assert rel <= 2e-2  # one bf16 rounding per operand
+
+
+def test_int8_error_within_documented_budget():
+    x, w = _xw(seed=5, m=32, k=64, n=48)
+    ref = np.asarray(x @ w)
+    y = np.asarray(quant_matmul(x, w, "int8", path="xla"))
+    assert y.dtype == np.float32
+    rel = np.abs(y - ref).max() / np.abs(ref).max()
+    assert rel <= 2e-2, rel  # observed ~8e-3 on unit normals
+
+
+def test_int8_paths_agree_and_batch_reshape():
+    # Pallas kernel (interpret mode off-TPU) vs the XLA fallback, on a
+    # multi-row-block shape (m=256 -> bm=128, 2 grid steps), an
+    # awkward row count (m=3 -> whole-array block), and a rank-3 x.
+    for m, k, n, seed in ((256, 32, 16, 6), (3, 32, 16, 7)):
+        x, w = _xw(seed=seed, m=m, k=k, n=n)
+        a = np.asarray(quant_matmul(x, w, "int8", path="xla"))
+        b = np.asarray(
+            quant_matmul(x, w, "int8", path="pallas", interpret=True)
+        )
+        np.testing.assert_allclose(a, b, rtol=1e-5, atol=1e-5)
+    x, w = _xw(seed=8, m=12, k=16, n=8)
+    x3 = x.reshape(3, 4, 16)
+    y3 = quant_matmul(x3, w, "int8", path="xla")
+    assert y3.shape == (3, 4, 8)
+    np.testing.assert_array_equal(
+        np.asarray(y3).reshape(12, 8),
+        np.asarray(quant_matmul(x, w, "int8", path="xla")),
+    )
+
+
+def test_quant_dot_selector():
+    assert quant_dot(None) is None
+    assert quant_dot("f32") is None
+    with pytest.raises(ValueError, match="compute_dtype"):
+        quant_dot("fp8")
+    x, w = _xw(seed=9)
+    for mode in ("bf16", "int8"):
+        dot = quant_dot(mode)
+        np.testing.assert_array_equal(
+            np.asarray(dot(x, w)),
+            np.asarray(quant_matmul(x, w, mode)),
+        )
+
+
+def test_policy_adds_bias_in_output_dtype():
+    x, w = _xw(seed=10)
+    b = jnp.asarray(np.random.RandomState(11).randn(48).astype(
+        np.float32
+    ))
+    pol = QuantMatmul(mode="int8")
+    for proj in (pol.column, pol.row):
+        np.testing.assert_array_equal(
+            np.asarray(proj(x, w, b)),
+            np.asarray(quant_matmul(x, w, "int8") + b),
+        )
+
+
+# ---------------------------------------------------- jaxpr dtype pins
+
+
+def test_traced_dot_dtypes_are_the_lint_contract():
+    """The CPU trace of each mode carries the operand dtypes hlolint's
+    `decode-quantized-matmul` rule pins (`lint.jaxpr_dot_records`):
+    int8 -> one s8 x s8 dot, bf16 -> one bf16 x bf16 dot, f32 -> one
+    f32 x f32 dot. Compiled HLO normalizes these away; the trace must
+    not."""
+    from distributed_model_parallel_tpu.analysis.lint import (
+        jaxpr_dot_records,
+    )
+
+    x, w = _xw(seed=12)
+    want = {"f32": ("f32", "f32"), "bf16": ("bf16", "bf16"),
+            "int8": ("s8", "s8")}
+    for mode, pair in want.items():
+        records = jaxpr_dot_records(
+            lambda x, w, mode=mode: quant_matmul(
+                x, w, mode, path="xla" if mode == "int8" else None
+            ),
+            x, w,
+        )
+        assert len(records) == 1
+        lhs, rhs, shape = records[0]
+        assert (lhs, rhs) == pair
+        assert shape == (32, 48)
