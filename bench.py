@@ -1442,7 +1442,13 @@ def run_child_serving(max_devices: int, platform: str = "cpu") -> None:
     Emits one partial JSON line per completed (layout, S) row — a
     wedge mid-sweep keeps the finished rows — then the table.
     Meaningful on a real slice; on virtual CPU devices the rings
-    serialize onto one core (the note in the JSON says so)."""
+    serialize onto one core (the note in the JSON says so).
+
+    Three end-to-end legs follow the microbench: chunked-prefill
+    admission vs monolithic, the prefix cache on/off, and speculative
+    decoding at k in {2, 4} vs plain decode (ISSUE 18 — accept rate,
+    tokens/s, and the lossless greedy pin, measured through eng.run
+    on a weight-stream-bound model with an exact-prefix draft)."""
     if max_devices < 1:
         raise ValueError(f"--max-devices must be >= 1, got {max_devices}")
     if platform == "cpu":
@@ -1775,10 +1781,151 @@ def run_child_serving(max_devices: int, platform: str = "cpu") -> None:
     print(json.dumps({"leg": {"prefix_cache": prefix},
                       "partial": True}), flush=True)
 
+    # --- speculative leg (ISSUE 18): draft-propose / one-pass-verify /
+    # lossless-accept vs plain decode, end-to-end through eng.run. The
+    # model is sized into the WEIGHT-STREAM regime speculation targets
+    # (dim 768 spills the per-step parameter read out of cache even on
+    # CPU; the tiny dim-64 microbench model above is dispatch-bound,
+    # where no draft can pay for itself), and the draft is an exact
+    # PREFIX of the target: the target's trailing three blocks have
+    # their residual writes (attn.out, ffn.out) zeroed — making each an
+    # identity block — so the 1-layer draft holding block 0's params
+    # produces bit-identical logits. That pins accept_rate at 1.0: the
+    # leg measures the MACHINERY's ceiling (rounds, rollback, verify
+    # amortization) with the model-pair quality factored out; the
+    # accept-dependent expectation is the cost engine's
+    # `speculative_expected_tokens` column, reconciled via predicted_ms
+    # (the closed-form roofline at THIS leg's dims — the replicated leg
+    # has no lint-matrix combo, those are tp-shaped).
+    from distributed_model_parallel_tpu.observability import cost
+
+    spec_cfg = GPTConfig(
+        vocab_size=128, dim=768, num_layers=4, num_heads=4,
+        ffn_dim=3072, max_position=64, dropout_rate=0.0,
+    )
+    spec_draft_cfg = GPTConfig(
+        vocab_size=128, dim=768, num_layers=1, num_heads=4,
+        ffn_dim=3072, max_position=64, dropout_rate=0.0,
+    )
+    spec_slots, spec_plen, spec_new = 8, 8, 48
+
+    def spec_engine(c, k):
+        return ServingEngine(
+            c, layout="replicated", num_slots=spec_slots, max_len=64,
+            prefill_len=spec_plen, page_size=page_size,
+            prefill_chunk=spec_plen, speculative_k=k,
+        )
+
+    spec_eng = spec_engine(spec_cfg, 0)
+    spec_params = spec_eng.init_params(jax.random.PRNGKey(0))
+    for blk in ("1", "2", "3"):  # identity blocks: residual writes -> 0
+        for branch in ("attn", "ffn"):
+            w = spec_params["blocks"][blk][branch]["out"]
+            w["w"] = jnp.zeros_like(w["w"])
+            w["b"] = jnp.zeros_like(w["b"])
+    spec_draft_eng = spec_engine(spec_draft_cfg, 0)
+    spec_draft_params = spec_draft_eng.init_params(jax.random.PRNGKey(1))
+    spec_draft_params["stem"] = spec_params["stem"]
+    spec_draft_params["blocks"]["0"] = spec_params["blocks"]["0"]
+    spec_draft_params["head"] = spec_params["head"]
+    spec_prompts = [
+        rng.randint(1, 128, size=spec_plen).astype(np.int32)
+        for _ in range(spec_slots)
+    ]
+
+    def spec_reqs():
+        return [Request(rid=i, prompt=spec_prompts[i],
+                        max_new_tokens=spec_new)
+                for i in range(spec_slots)]
+
+    # Closed-form roofline at the leg's true dims (shards=1): decode
+    # step, verify step, and the amortized per-accepted-token round
+    # cost at the leg's PINNED accept rate and true draft ratio (1 of
+    # 4 layers). Units: ms to emit one token per slot — the same unit
+    # as the measured step-equivalent below.
+    spec_decode_pred_s = cost.serve_decode_compute_s(
+        spec_cfg.num_layers, spec_cfg.dim, spec_cfg.ffn_dim, spec_slots,
+    )
+    speculative = {}
+    spec_plain_rep = None
+    spec_plain_tokens = None
+    for k in (0, 2, 4):
+        eng_k = spec_eng if k == 0 else spec_engine(spec_cfg, k)
+        kwargs = {} if k == 0 else {
+            "draft": spec_draft_eng,
+            "draft_params": spec_draft_params,
+        }
+        eng_k.run(spec_params, spec_reqs(), **kwargs)  # warmup compile
+        sched = eng_k.run(spec_params, spec_reqs(), **kwargs)
+        rep = sched.latency_report()
+        row = {
+            "speculative_k": k,
+            "tokens_per_s": rep["tokens_per_s"],
+            "decode_p50_ms": rep["decode_p50_ms"],
+            "decode_p99_ms": rep["decode_p99_ms"],
+            "generated_tokens": rep["generated_tokens"],
+            # ms per one-token-per-slot step-equivalent — comparable
+            # across k (a verify round emits several per slot).
+            "step_equiv_ms": round(
+                spec_slots * 1e3 / rep["tokens_per_s"], 3
+            ) if rep["tokens_per_s"] else None,
+        }
+        if k == 0:
+            spec_plain_rep = rep
+            spec_plain_tokens = {
+                f.rid: f.tokens for f in sched.finished
+            }
+            row["predicted_ms"] = round(spec_decode_pred_s * 1e3, 6)
+        else:
+            sp = rep["speculative"]
+            row.update({
+                "accept_rate": sp["accept_rate"],
+                "mean_accept_len": sp["mean_accept_len"],
+                "verify_rounds": sp["verify_rounds"],
+                "spec_tokens": sp["spec_tokens"],
+                "draft_layers": spec_draft_cfg.num_layers,
+                "speedup_vs_plain_pct": round(
+                    100.0 * (rep["tokens_per_s"]
+                             / spec_plain_rep["tokens_per_s"] - 1), 1
+                ),
+                # The lossless pin, in-row: greedy speculative output
+                # must be BIT-IDENTICAL to the plain engine's.
+                "greedy_matches_plain": all(
+                    f.tokens == spec_plain_tokens[f.rid]
+                    for f in sched.finished
+                ),
+                "predicted_ms": round(cost.serve_speculative_token_s(
+                    spec_decode_pred_s,
+                    cost.serve_verify_compute_s(
+                        spec_cfg.num_layers, spec_cfg.dim,
+                        spec_cfg.ffn_dim, spec_slots, k,
+                    ),
+                    k, accept_rate=sp["accept_rate"],
+                    draft_cost_ratio=(
+                        spec_draft_cfg.num_layers / spec_cfg.num_layers
+                    ),
+                ) * 1e3, 6),
+            })
+        row["predicted_src"] = (
+            "cost closed form @ leg dims (HBM roofline, shards=1)"
+        )
+        if row["step_equiv_ms"] and row["predicted_ms"]:
+            row["delta_pct"] = round(
+                (row["step_equiv_ms"] - row["predicted_ms"])
+                / row["predicted_ms"] * 100.0, 1
+            )
+        speculative[f"k{k}" if k else "plain"] = row
+        log(f"speculative k={k}: {row['tokens_per_s']} tok/s"
+            + (f" ({row['speedup_vs_plain_pct']:+.1f}% vs plain, "
+               f"accept {row['accept_rate']})" if k else ""))
+        print(json.dumps({"leg": {"speculative": row},
+                          "partial": True}), flush=True)
+
     out = {
         "serving_microbench": rows,
         "serving_admission": admission,
         "serving_prefix": prefix,
+        "serving_speculative": speculative,
         "page_size": page_size,
         "platform": jax.devices()[0].platform,
         "device_kind": jax.devices()[0].device_kind,

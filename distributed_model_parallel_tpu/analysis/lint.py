@@ -84,6 +84,14 @@ class Combo:
     # decode-quantized-matmul).
     compute_dtype: Optional[str] = None
 
+    # Speculative decoding (engine == "serve", ISSUE 18): 0 keeps the
+    # plain decode step (every pre-existing serve combo name and
+    # ledger row byte-stable); k > 0 lowers the VERIFY step instead —
+    # the (slots, k+1) chunk-shaped pass rule spec-verify-step pins at
+    # one decode step's ring inventory. Requires page_size (rollback
+    # truncates the block table).
+    speculative_k: int = 0
+
     @property
     def name(self) -> str:
         bits = [self.engine, f"S{self.size}"]
@@ -113,6 +121,8 @@ class Combo:
             bits.append("bf16")
         if self.compute_dtype is not None:
             bits.append(f"q-{self.compute_dtype}")
+        if self.speculative_k:
+            bits.append(f"spec{self.speculative_k}")
         return "/".join(bits)
 
 
@@ -959,11 +969,46 @@ def _build_serve(combo: Combo, devices):
             or (jnp.bfloat16 if combo.bf16 else None)
         ),
         page_size=combo.page_size,
+        speculative_k=combo.speculative_k,
     )
     params = eng.init_params(jax.random.PRNGKey(0))
     cache = eng.init_cache()
     tokens = jnp.zeros((eng.num_slots,), jnp.int32)
     active = jnp.ones((eng.num_slots,), jnp.bool_)
+    if combo.speculative_k:
+        # The VERIFY step (ISSUE 18): scores k+1 positions per slot in
+        # one chunk-shaped pass. Rule spec-verify-step pins its ring
+        # inventory at ONE decode step's — the chunk axis must ride
+        # the rings' local operand, never the fabric.
+        host = eng.new_host()
+        for slot in range(eng.num_slots):
+            host.ensure_pages(slot, 8 + combo.speculative_k + 1)
+        positions = jnp.full((eng.num_slots,), 8, jnp.int32)
+        tokens_chunk = jnp.zeros(
+            (eng.num_slots, combo.speculative_k + 1), jnp.int32
+        )
+        step_args = (
+            params, cache, host.device_table(), positions,
+            tokens_chunk, active,
+        )
+        expected = (
+            decode_ring_permutes(cfg.num_layers, s)
+            if combo.collective_matmul else None
+        )
+        hlo = eng.verify_step.lower(*step_args).compile().as_text()
+        target = LintTarget(
+            name=combo.name, engine="serve", donate=True,
+            bf16=combo.bf16,
+            collective_matmul=combo.collective_matmul,
+            cm_axis="model" if combo.collective_matmul else None,
+            cm_size=s,
+            cm_min_ring_permutes=expected or 0,
+            speculative_k=combo.speculative_k,
+            spec_verify_permutes=expected,
+            n_param_leaves=2,  # the paged cache donates {k, v}
+            **_mesh_facts(mesh),
+        )
+        return target, hlo, mesh
     if combo.page_size is not None:
         # The paged step: block-table gathers/scatters are LOCAL
         # indexing ops, so the decode collective inventory — and
@@ -1116,6 +1161,17 @@ def full_matrix() -> List[Combo]:
     combos.append(Combo("serve", 2, page_size=8,
                         collective_matmul=True,
                         compute_dtype="int8"))
+    # Speculative verify step (ISSUE 18, rule spec-verify-step): the
+    # one-pass verify must carry exactly one decode step's ring
+    # inventory — pinned at S in {2, 4} and k in {2, 4} on the
+    # paged+ringed layout, plus a declarative paged combo (generic
+    # rules only) so the k>0 lowering itself stays covered without
+    # rings. (serve/S2/pg8/cm/spec2 rides in via pregate_matrix().)
+    combos.append(Combo("serve", 2, page_size=8, speculative_k=2))
+    combos.append(Combo("serve", 4, page_size=8,
+                        collective_matmul=True, speculative_k=2))
+    combos.append(Combo("serve", 2, page_size=8,
+                        collective_matmul=True, speculative_k=4))
     combos += [Combo("pipeline", 2), Combo("pipeline", 4)]
     combos.append(Combo("tp", 4, collective_matmul=True, bf16=True))
     combos.append(Combo("sp", 4, collective_matmul=True, bf16=True))
@@ -1169,10 +1225,12 @@ def pregate_matrix() -> List[Combo]:
     combo on a hybrid fabric, so a dispatch regression fails in seconds
     with `moe-hierarchical-a2a` named, one tinycnn-sized quantized
     hybrid combo so a broken wire codec fails with
-    `dcn-compressed-payload` named, and one quantized ringed serve
+    `dcn-compressed-payload` named, one quantized ringed serve
     combo so a broken quantized decode path fails with
     `decode-quantized-matmul` (or a broken ring with
-    `serve-decode-ring`) named."""
+    `serve-decode-ring`) named, and one speculative paged+ringed serve
+    combo so a verify step that falls off the rings fails with
+    `spec-verify-step` named."""
     return [
         Combo("ddp", 8, grad_reduction="overlapped", model="tinycnn"),
         Combo("fsdp", 8, grad_reduction="overlapped", model="tinycnn"),
@@ -1182,6 +1240,8 @@ def pregate_matrix() -> List[Combo]:
               dcn_compression="int8", model="tinycnn"),
         Combo("serve", 2, collective_matmul=True,
               compute_dtype="int8"),
+        Combo("serve", 2, page_size=8, collective_matmul=True,
+              speculative_k=2),
     ]
 
 

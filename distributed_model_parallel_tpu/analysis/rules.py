@@ -91,6 +91,13 @@ class LintTarget:
     # the exact `serve_ring`-tagged permute count of one decode step,
     # 4 projection rings per block x (S-1) hops (PR 7).
     serve_decode_permutes: Optional[int] = None
+    # Speculative-verify expectation (ISSUE 18, engine == "serve" with
+    # speculative_k > 0): the verify step scores k+1 positions per slot
+    # in one pass, and its ring inventory must be EXACTLY one decode
+    # step's — the same 4*layers*(S-1) `serve_ring` permutes, zero
+    # monolithic collectives over the TP axis (rule spec-verify-step).
+    speculative_k: int = 0
+    spec_verify_permutes: Optional[int] = None
     # jaxpr metadata: ((axis_names, dtype_token, scope), ...) for every
     # `ppermute` equation in the traced step. Compiled CPU HLO cannot
     # carry dtype contracts (the backend's float-normalization pass
@@ -605,7 +612,8 @@ def _prefetch_gather_free(ctx: LintContext) -> List[Finding]:
         "projections never fall back to the partitioner's fused "
         "collectives."
     ),
-    applies=lambda t: t.engine == "serve" and t.collective_matmul,
+    applies=lambda t: t.engine == "serve" and t.collective_matmul
+    and not t.speculative_k,
 )
 def _serve_decode_ring(ctx: LintContext) -> List[Finding]:
     t = ctx.target
@@ -629,6 +637,49 @@ def _serve_decode_ring(ctx: LintContext) -> List[Finding]:
             "serve-decode-ring",
             f"{c.name}: monolithic {c.kind} crossing '{t.cm_axis}' on "
             "an opted-in decode step",
+            c.name,
+        ))
+    return out
+
+
+@rule(
+    id="spec-verify-step", severity="error", source="ISSUE 18",
+    contract=(
+        "A speculative verify step on an opted-in serving combo "
+        "amortizes k+1 scored positions over ONE decode step's wire "
+        "traffic: exactly 4*layers*(S-1) `serve_ring`-tagged "
+        "collective-permutes (the chunk axis rides the rings' local "
+        "operand, never the fabric) and ZERO monolithic all-gather/"
+        "reduce-scatter crossing the TP axis — if verify cost scaled "
+        "with k on the wire, speculative decoding's win would vanish "
+        "at exactly the batch sizes it targets."
+    ),
+    applies=lambda t: t.engine == "serve" and t.collective_matmul
+    and t.speculative_k > 0,
+)
+def _spec_verify_step(ctx: LintContext) -> List[Finding]:
+    t = ctx.target
+    out = []
+    if t.spec_verify_permutes is None:
+        return [ctx.finding(
+            "spec-verify-step",
+            "no spec_verify_permutes expectation on a speculative "
+            "serving combo — the verify ring pin was not checked",
+        )]
+    tagged = ctx.module.tagged("serve_ring", "collective-permute")
+    if len(tagged) != t.spec_verify_permutes:
+        out.append(ctx.finding(
+            "spec-verify-step",
+            f"{len(tagged)} serve_ring-tagged permutes in the verify "
+            f"step, expected exactly {t.spec_verify_permutes} — one "
+            f"decode step's inventory (4 rings/block x (S-1) hops), "
+            f"independent of k={t.speculative_k}",
+        ))
+    for c in monolithic_over(ctx.collectives, t.cm_axis):
+        out.append(ctx.finding(
+            "spec-verify-step",
+            f"{c.name}: monolithic {c.kind} crossing '{t.cm_axis}' in "
+            "a speculative verify step",
             c.name,
         ))
     return out

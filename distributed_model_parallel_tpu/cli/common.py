@@ -662,6 +662,75 @@ def check_serving_args(args) -> None:
             "greedy default (--temperature 0) they would silently do "
             "nothing — set --temperature > 0"
         )
+    # --- speculative decoding (serving/speculative.py) ---------------
+    spec_k = getattr(args, "speculative_k", 0)
+    if spec_k < 0 or spec_k > 8:
+        raise SystemExit(
+            f"--speculative-k must be in [0, 8] (0 = off; past ~8 the "
+            f"verify step's wasted work dominates), got {spec_k}"
+        )
+    if spec_k:
+        if args.layout == "sp":
+            raise SystemExit(
+                "--speculative-k is not supported under --layout sp: "
+                "the verify step rides the chunk-shaped paged decode "
+                "path, which sp's shard_map decode does not lower — "
+                "use the replicated/tp layouts"
+            )
+        if not args.page_size:
+            raise SystemExit(
+                "--speculative-k rolls rejected draft suffixes back by "
+                "TRUNCATING THE BLOCK TABLE; it requires --page-size "
+                "(the contiguous layout has no page-granular rollback)"
+            )
+        if spec_k + 1 >= args.max_len:
+            raise SystemExit(
+                f"--speculative-k {spec_k} writes k+1 positions per "
+                f"verify round; --max-len {args.max_len} cannot hold "
+                "one round past the prompt"
+            )
+        draft_layers = getattr(args, "speculative_draft_layers", 0)
+        if draft_layers < 0:
+            raise SystemExit(
+                f"--speculative-draft-layers must be >= 0 (0 = "
+                f"max(1, --layers // 2)), got {draft_layers}"
+            )
+        if getattr(args, "speculative_draft", None) and draft_layers:
+            raise SystemExit(
+                "--speculative-draft-layers sizes a FRESH-INIT draft; "
+                "--speculative-draft supplies the draft's dims from "
+                "its recorded config — drop one of the flags"
+            )
+    else:
+        for val, flag in (
+            (getattr(args, "speculative_draft", None),
+             "--speculative-draft"),
+            (getattr(args, "speculative_draft_layers", 0),
+             "--speculative-draft-layers"),
+        ):
+            if val:
+                raise SystemExit(
+                    f"{flag} configures the draft model for "
+                    "speculative decoding; set --speculative-k >= 1 "
+                    "as well (0 = off)"
+                )
+    # --- synthetic arrivals (Poisson offered load) -------------------
+    rate = getattr(args, "arrival_rate", 0.0)
+    burst = getattr(args, "arrival_burst", 1)
+    if rate < 0:
+        raise SystemExit(
+            f"--arrival-rate must be >= 0 (0 = all requests arrive "
+            f"at t=0), got {rate}"
+        )
+    if burst < 1:
+        raise SystemExit(
+            f"--arrival-burst must be >= 1, got {burst}"
+        )
+    if burst > 1 and not rate:
+        raise SystemExit(
+            "--arrival-burst groups Poisson arrival events into "
+            "bursts; set --arrival-rate > 0 as well"
+        )
 
 
 def compute_dtype_from_flag(name: str):

@@ -123,6 +123,41 @@ def build_parser() -> argparse.ArgumentParser:
                         "repeated system prompt skips its prefill; "
                         "copy-on-write on the first divergent write "
                         "(needs --page-size and --prefill-chunk)")
+    # Speculative decoding (serving/speculative.py): a small draft GPT
+    # proposes k tokens per slot, the target scores all k+1 in ONE
+    # verify step; acceptance is lossless (greedy output bit-identical
+    # to plain decode) so these knobs are pure latency tuning.
+    p.add_argument("--speculative-k", default=0, type=int,
+                   help="draft tokens proposed per verify round "
+                        "(0 = off; needs --page-size — rejected "
+                        "suffixes roll back by truncating the block "
+                        "table). Works with --prefix-cache: prefix "
+                        "pages are a TARGET-side shortcut, the draft "
+                        "always ingests prompts itself")
+    p.add_argument("--speculative-draft", default=None, metavar="DIR",
+                   help="draft model checkpoint: newest snapshot in "
+                        "DIR, dims taken from its recorded config "
+                        "(vocab must match the target's, recorded "
+                        "max_position must cover --max-len). Omit for "
+                        "a fresh-init draft sized by "
+                        "--speculative-draft-layers")
+    p.add_argument("--speculative-draft-layers", default=0, type=int,
+                   help="layer count of the fresh-init draft when no "
+                        "--speculative-draft checkpoint is given "
+                        "(0 = max(1, --layers // 2); other dims "
+                        "mirror the target)")
+    # Synthetic arrivals: offered load instead of all-at-t=0. The
+    # engine still consumes requests in submission order (this sandbox
+    # has no live clock), so arrival times feed the offered-load vs
+    # goodput report line, not the admission loop.
+    p.add_argument("--arrival-rate", default=0.0, type=float,
+                   help="Poisson arrival-EVENT rate in events/s for "
+                        "the synthetic trace (0 = every request "
+                        "arrives at t=0)")
+    p.add_argument("--arrival-burst", default=1, type=int,
+                   help="requests arriving per Poisson event (bursty "
+                        "traffic: same offered load, lumpier queue; "
+                        "needs --arrival-rate > 0)")
     # Decode-time sampling (serving/sampling.py; greedy default is
     # bit-stable — temperature 0 never touches an RNG).
     p.add_argument("--temperature", default=0.0, type=float,
@@ -184,6 +219,24 @@ def synthetic_trace(args) -> list:
     return out
 
 
+def synthetic_arrivals(args) -> np.ndarray:
+    """Arrival time (seconds) per request under the --arrival-rate /
+    --arrival-burst model: Poisson events (exponential inter-arrival
+    gaps at the event rate), --arrival-burst requests sharing each
+    event's timestamp. Deterministic in --seed (its own RNG stream, so
+    adding arrival flags never perturbs the prompt content). Rate 0 is
+    the legacy all-at-t=0 trace."""
+    if not args.arrival_rate:
+        return np.zeros(args.num_requests, np.float64)
+    rng = np.random.RandomState(args.seed + 0x5EED)
+    n_events = -(-args.num_requests // args.arrival_burst)  # ceil
+    gaps = rng.exponential(
+        1.0 / args.arrival_rate, size=n_events
+    )
+    events = np.cumsum(gaps)
+    return np.repeat(events, args.arrival_burst)[:args.num_requests]
+
+
 # GPTConfig fields recorded by the lm CLI (checkpoint_extra) -> the
 # serve flag that controls each, for mismatch messages a user can act
 # on. max_position is driven by --max-len (the cache length IS the
@@ -233,6 +286,79 @@ def _checkpoint_guard(directory: str, name: str, cfg) -> None:
                 f"{field}={want} — adjust {flag} to match the trained "
                 "model"
             )
+
+
+def _draft_config(args, target_cfg) -> "tuple[GPTConfig, str | None]":
+    """Resolve the draft GPT's config for speculative decoding.
+
+    With --speculative-draft, the dims come from the checkpoint's
+    recorded gpt_config (the PR-8 checkpoint_extra record) — a draft is
+    a DIFFERENT model, so no serve flag describes it; compatibility
+    with the target (same vocabulary, position table covering
+    --max-len) is checked here, before any engine compiles. Without a
+    checkpoint, the draft is a fresh-init layers-truncated twin of the
+    target. Returns (config, checkpoint name or None)."""
+    if not args.speculative_draft:
+        import dataclasses
+
+        layers = args.speculative_draft_layers or max(
+            1, args.layers // 2
+        )
+        return dataclasses.replace(
+            target_cfg, num_layers=layers
+        ), None
+    from distributed_model_parallel_tpu.checkpointing import (
+        checkpoint_metadata,
+    )
+    from distributed_model_parallel_tpu.training.checkpoint import (
+        newest_checkpoint_name,
+    )
+
+    name = newest_checkpoint_name(args.speculative_draft)
+    try:
+        meta = checkpoint_metadata(args.speculative_draft, name)
+    except FileNotFoundError as e:
+        raise SystemExit(str(e))
+    recorded = meta.get("gpt_config")
+    if not recorded:
+        raise SystemExit(
+            f"--speculative-draft {args.speculative_draft}: the "
+            "checkpoint has no recorded gpt_config, so the draft's "
+            "dims are unknowable from flags — re-save it with a "
+            "current trainer (checkpoint_extra records the config)"
+        )
+    if int(recorded.get("num_experts", 0)) > 0:
+        raise SystemExit(
+            f"--speculative-draft {args.speculative_draft}: the draft "
+            f"is a Mixture-of-Experts LM (num_experts="
+            f"{recorded['num_experts']}); the serving engine builds "
+            "dense decoder blocks and cannot serve it"
+        )
+    if int(recorded["vocab_size"]) != target_cfg.vocab_size:
+        raise SystemExit(
+            f"--speculative-draft {args.speculative_draft}: draft "
+            f"vocab_size {recorded['vocab_size']} != target "
+            f"vocab_size {target_cfg.vocab_size} — speculative "
+            "acceptance compares the two models' distributions over "
+            "the SAME vocabulary"
+        )
+    if int(recorded["max_position"]) < args.max_len:
+        raise SystemExit(
+            f"--speculative-draft {args.speculative_draft}: draft "
+            f"max_position {recorded['max_position']} < --max-len "
+            f"{args.max_len} — the draft cache mirrors the target's "
+            "positions, so its position table must cover them"
+        )
+    return GPTConfig(
+        vocab_size=int(recorded["vocab_size"]),
+        dim=int(recorded["dim"]),
+        num_layers=int(recorded["num_layers"]),
+        num_heads=int(recorded["num_heads"]),
+        ffn_dim=int(recorded["ffn_dim"]),
+        max_position=int(recorded["max_position"]),
+        dropout_rate=0.0,
+        pad_token_id=0,
+    ), name
 
 
 def main(argv=None) -> dict:
@@ -320,7 +446,62 @@ def main(argv=None) -> dict:
         num_pages=args.kv_pages or None,
         prefill_chunk=args.prefill_chunk or None,
         prefix_cache=args.prefix_cache,
+        speculative_k=args.speculative_k,
     )
+    draft_engine = draft_params = None
+    if args.speculative_k:
+        draft_cfg, draft_ckpt = _draft_config(args, cfg)
+        # The draft mirrors every target layout knob (speculative.py's
+        # check_draft_engine enforces the cache-shape ones) EXCEPT
+        # prefix_cache: prefix pages are a target-side shortcut — the
+        # draft always ingests prompts itself.
+        draft_engine = ServingEngine(
+            draft_cfg, mesh,
+            layout=args.layout,
+            num_slots=args.num_slots,
+            max_len=args.max_len,
+            prefill_len=args.prefill_len,
+            collective_matmul=args.collective_matmul,
+            compute_dtype=serve_compute_dtype(args),
+            page_size=args.page_size or None,
+            num_pages=args.kv_pages or None,
+            prefill_chunk=args.prefill_chunk or None,
+        )
+        if draft_ckpt is not None:
+            import jax.numpy as jnp
+
+            from distributed_model_parallel_tpu.checkpointing import (
+                restore_subtree,
+            )
+
+            key_aval = jax.ShapeDtypeStruct((2,), jnp.uint32)
+            d_aval, _ = jax.eval_shape(
+                draft_engine._full.init, key_aval
+            )
+            try:
+                draft_raw, _ = restore_subtree(
+                    args.speculative_draft, d_aval, name=draft_ckpt,
+                )
+            except (FileNotFoundError, KeyError, ValueError) as e:
+                raise SystemExit(
+                    f"--speculative-draft {args.speculative_draft}: {e}"
+                )
+            draft_params = draft_engine.place_params(draft_raw)
+            if jax.process_index() == 0:
+                print(
+                    f"==> speculative draft "
+                    f"{args.speculative_draft} ({draft_ckpt}, "
+                    f"{draft_cfg.num_layers} layers, k="
+                    f"{args.speculative_k})",
+                    flush=True,
+                )
+        else:
+            # Fresh-init draft: a real deployment trains/distills one;
+            # this keeps the full speculative path exercisable from
+            # the CLI with no checkpoint on disk.
+            draft_params = draft_engine.init_params(
+                jax.random.PRNGKey(args.seed + 1)
+            )
     if args.checkpoint:
         import jax.numpy as jnp
 
@@ -369,8 +550,40 @@ def main(argv=None) -> dict:
             temperature=args.temperature, top_k=args.top_k,
             top_p=args.top_p, seed=args.seed,
         )
-    sched = engine.run(params, requests, sampling=sampling)
+    sched = engine.run(
+        params, requests, sampling=sampling,
+        draft=draft_engine, draft_params=draft_params,
+    )
     report = sched.latency_report()
+    arrivals = synthetic_arrivals(args)
+    if args.arrival_rate:
+        # Offered load vs achieved goodput: how much decode the trace
+        # ASKED for per second vs the useful fraction of slot-steps
+        # the engine actually ran. span = last arrival + one mean
+        # inter-event gap (the last burst still wants its tokens), so
+        # offered load stays finite even for a single burst.
+        span = float(arrivals[-1]) + 1.0 / args.arrival_rate
+        offered_req_s = args.num_requests / span
+        report["offered_load"] = {
+            "arrival_rate": args.arrival_rate,
+            "arrival_burst": args.arrival_burst,
+            "offered_req_per_s": round(offered_req_s, 3),
+            "offered_tokens_per_s": round(
+                offered_req_s * args.max_new_tokens, 3
+            ),
+            "goodput": report.get("goodput"),
+            "achieved_tokens_per_s": report.get("tokens_per_s"),
+        }
+        if jax.process_index() == 0:
+            print(
+                f"==> offered load "
+                f"{report['offered_load']['offered_tokens_per_s']} "
+                f"tok/s ({args.arrival_rate} ev/s x "
+                f"{args.arrival_burst}/burst) vs achieved "
+                f"{report.get('tokens_per_s')} tok/s, goodput "
+                f"{report.get('goodput')}",
+                flush=True,
+            )
     if args.metrics_out:
         from distributed_model_parallel_tpu.cli.common import (
             export_metrics_out,
@@ -410,6 +623,8 @@ def main(argv=None) -> dict:
             "prefill_chunk": args.prefill_chunk or None,
             "prefix_cache": args.prefix_cache,
             "temperature": args.temperature,
+            "speculative_k": args.speculative_k or None,
+            "speculative_draft": args.speculative_draft,
             **report,
         },
         "requests": per_request,

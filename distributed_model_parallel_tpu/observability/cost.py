@@ -82,6 +82,18 @@ MXU_RATE = {  # flop/s (multiply-accumulate = 2 flop) per compute mode
 # (int8 streams quantized weights; the f32 scale sidecars are noise).
 COMPUTE_ITEMSIZE = {"f32": 4, "bf16": 2, "int8": 1}
 
+# Speculative-decoding workload assumptions (ISSUE 18,
+# `serving/speculative.py`). NOT physics: the accept rate is a model
+# pairing property (how often the draft's proposals survive the
+# target's verify) and the draft-cost ratio an architecture property —
+# the serve CLI's default draft runs layers//2 of the target stack at
+# the same width, so one draft step streams ~half the projection
+# weights of one target decode step. Both live in COMPUTE_CONSTANTS so
+# the ledger records and drift-checks the assumptions every committed
+# speculative row was priced under.
+SPEC_MODEL_ACCEPT = 0.7
+DRAFT_COST_RATIO = 0.5
+
 #: Every constant the predictions depend on, by name — recorded in the
 #: ledger so `tools/costgate` can refuse to compare predictions made
 #: under different physics. CONSTANTS is the comm-fabric set the
@@ -101,6 +113,11 @@ COMPUTE_CONSTANTS: Dict[str, float] = {
     "mxu_f32_flop_per_s": MXU_RATE["f32"],
     "mxu_bf16_flop_per_s": MXU_RATE["bf16"],
     "mxu_int8_flop_per_s": MXU_RATE["int8"],
+    # Speculative workload assumptions (ISSUE 18) ride the compute set:
+    # hand-only (the CPU sandbox cannot measure a real draft/target
+    # pairing), recorded so a changed assumption forces a full reprice.
+    "spec_model_accept_rate": SPEC_MODEL_ACCEPT,
+    "spec_draft_cost_ratio": DRAFT_COST_RATIO,
 }
 
 
@@ -404,6 +421,119 @@ def serve_combo_compute_s(combo,
     )
 
 
+# ------------------------------------- speculative decoding (ISSUE 18)
+
+
+def speculative_expected_tokens(accept_rate: float, k: int) -> float:
+    """Expected ACCEPTED tokens per speculative round (Leviathan et
+    al., ICML'23 eq. 1): position i of the k drafts lands iff all of
+    its predecessors did, and the round always emits one bonus token —
+    sum_{i=0..k} acc^i = (1 - acc^(k+1)) / (1 - acc). k=0 degenerates
+    to 1.0 (plain decode); acc=1 to k+1 (every draft survives)."""
+    if k <= 0:
+        return 1.0
+    if not 0.0 <= accept_rate <= 1.0:
+        raise ValueError(
+            f"accept_rate must be in [0, 1], got {accept_rate!r}"
+        )
+    if accept_rate >= 1.0:
+        return float(k + 1)
+    return (1.0 - accept_rate ** (k + 1)) / (1.0 - accept_rate)
+
+
+def serve_verify_compute_s(layers: int, dim: int, ffn_dim: int,
+                           n_slots: int, speculative_k: int,
+                           mode: str = "f32", shards: int = 1,
+                           constants: Optional[
+                               Dict[str, float]] = None) -> float:
+    """Projection-GEMM roofline of ONE speculative verify step: the
+    decode form with m = n_slots * (k+1) — the target scores all k
+    draft positions plus the bonus in a single chunk-shaped pass. At
+    decode batch sizes the k*n weight STREAM binds the roofline and is
+    independent of m, so the verify step prices (almost) identically
+    to one plain decode step — which is exactly the win the per-token
+    form below amortizes over the expected accepted tokens."""
+    return serve_decode_compute_s(
+        layers, dim, ffn_dim, n_slots * (speculative_k + 1), mode,
+        shards, constants,
+    )
+
+
+def serve_speculative_token_s(decode_step_s: float,
+                              verify_step_s: float, speculative_k: int,
+                              accept_rate: Optional[float] = None,
+                              draft_cost_ratio: Optional[float] = None,
+                              constants: Optional[
+                                  Dict[str, float]] = None) -> float:
+    """Advisory per-ACCEPTED-token cost of the speculative serving
+    path: one round = k draft decode steps (each DRAFT_COST_RATIO of a
+    plain target step) + ONE verify step, amortized over the round's
+    expected accepted tokens. Defaults come from COMPUTE_CONSTANTS so
+    the ledger drift-checks the assumptions; explicit overrides let
+    `bench.py` put a MEASURED accept rate next to the model's."""
+    if speculative_k < 1:
+        raise ValueError(
+            "serve_speculative_token_s prices k >= 1 rounds (a plain "
+            "decode step IS the k=0 per-token cost)"
+        )
+    c = _resolve_compute_constants(constants)
+    acc = c["spec_model_accept_rate"] if accept_rate is None \
+        else accept_rate
+    ratio = c["spec_draft_cost_ratio"] if draft_cost_ratio is None \
+        else draft_cost_ratio
+    e = speculative_expected_tokens(acc, speculative_k)
+    return (speculative_k * ratio * decode_step_s + verify_step_s) / e
+
+
+def serve_speculative_request_s(prompt_tokens: int, new_tokens: int,
+                                token_bytes: int, page_size: int,
+                                prefill_chunk: int, speculative_k: int,
+                                decode_compute_s: float = 0.0,
+                                verify_compute_s: float = 0.0,
+                                constants: Optional[
+                                    Dict[str, float]] = None,
+                                compute_constants: Optional[
+                                    Dict[str, float]] = None) -> float:
+    """Per-request closed form of SPECULATIVE paged serving (the serve
+    tuning family's k >= 1 form; `tuning/search.serve_closed_form_s`
+    dispatches here). Prefill and page-allocation terms follow
+    `serve_paged_request_s` — the draft ingests every prompt itself
+    (prefix cache is target-side only), charged at DRAFT_COST_RATIO of
+    the target's prefill — and the per-token decode loop is replaced
+    by new_tokens / E speculative rounds priced by
+    `serve_speculative_token_s`, each step one page of write-back plus
+    its compute term. Same CPU-physics honesty note as every closed
+    form here: the constants rank configurations."""
+    if page_size < 1 or prefill_chunk < 1:
+        raise ValueError(
+            "serve_speculative_request_s prices paged+chunked serving: "
+            f"page_size ({page_size}) and prefill_chunk "
+            f"({prefill_chunk}) must be >= 1"
+        )
+    if speculative_k < 1:
+        raise ValueError(
+            "serve_speculative_request_s prices k >= 1 "
+            "(serve_paged_request_s is the k=0 form)"
+        )
+    bw_ici, a_ici, _, _ = _resolve_constants(constants)
+    cc = _resolve_compute_constants(compute_constants)
+    total_tokens = prompt_tokens + new_tokens
+    chunks = -(-prompt_tokens // prefill_chunk)
+    prefill = chunks * a_ici \
+        + chunks * prefill_chunk * token_bytes / bw_ici
+    allocations = -(-total_tokens // page_size) * a_ici
+    step_comm = a_ici + page_size * token_bytes / bw_ici
+    token_s = serve_speculative_token_s(
+        step_comm + decode_compute_s, step_comm + verify_compute_s,
+        speculative_k, constants=compute_constants,
+    )
+    return (
+        (1.0 + cc["spec_draft_cost_ratio"]) * prefill
+        + allocations
+        + new_tokens * token_s
+    )
+
+
 # ------------------------------------------------------ the HLO walker
 
 
@@ -548,13 +678,50 @@ def add_serve_compute(row: dict, combo,
     """Fold the decode-compute roofline into one serve ledger row —
     f32 combos too, so the cross-dtype deltas are visible in the
     committed ledger (`decode_compute_s` carries the mode's own term;
-    `predicted_step_s` stays the single gated number)."""
+    `predicted_step_s` stays the single gated number).
+
+    Speculative combos (ISSUE 18): the lowered HLO for a
+    `speculative_k > 0` serve combo IS the verify step, so the comm
+    breakdown already in `row` is the verify step's. The gated number
+    becomes the per-ACCEPTED-token cost of one speculative round
+    (`serve_speculative_token_s` over comm+compute steps) — directly
+    comparable to a plain combo's per-step (= per-token) number, which
+    is what lets the tuner's lowering tier rank k > 0 candidates
+    against k = 0 on the same axis."""
     compute_s = serve_combo_compute_s(combo, constants)
     row = dict(row)
     row["compute_dtype"] = combo.compute_dtype or "f32"
     row["decode_compute_s"] = round(compute_s, 12)
+    k = getattr(combo, "speculative_k", 0)
+    if not k:
+        row["predicted_step_s"] = round(
+            row["predicted_step_s"] + compute_s, 9
+        )
+        return row
+    comm_s = row["predicted_step_s"]  # the verify step's lowered comm
+    verify_s = serve_verify_compute_s(
+        layers=2, dim=16, ffn_dim=32, n_slots=2 * combo.size,
+        speculative_k=k, mode=combo.compute_dtype or "f32",
+        shards=combo.size, constants=constants,
+    )
+    cc = _resolve_compute_constants(constants)
+    row["verify_compute_s"] = round(verify_s, 12)
+    row["speculative"] = {
+        "k": k,
+        "accept_rate": cc["spec_model_accept_rate"],
+        "draft_cost_ratio": cc["spec_draft_cost_ratio"],
+        "expected_tokens_per_round": round(
+            speculative_expected_tokens(
+                cc["spec_model_accept_rate"], k
+            ), 6
+        ),
+        "verify_step_s": round(comm_s + verify_s, 9),
+    }
     row["predicted_step_s"] = round(
-        row["predicted_step_s"] + compute_s, 9
+        serve_speculative_token_s(
+            comm_s + compute_s, comm_s + verify_s, k,
+            constants=constants,
+        ), 9
     )
     return row
 
@@ -570,9 +737,11 @@ __all__ = [
     "CONSTANTS",
     "CostBreakdown",
     "DCN",
+    "DRAFT_COST_RATIO",
     "Fabric",
     "ICI",
     "MXU_RATE",
+    "SPEC_MODEL_ACCEPT",
     "WIRE_ITEMSIZE",
     "add_serve_compute",
     "combo_cost",
@@ -583,6 +752,10 @@ __all__ = [
     "quant_matmul_s",
     "serve_decode_compute_s",
     "serve_paged_request_s",
+    "serve_speculative_request_s",
+    "serve_speculative_token_s",
+    "serve_verify_compute_s",
+    "speculative_expected_tokens",
     "load_calibration",
     "predict_collectives",
     "ring_all_reduce_s",

@@ -100,6 +100,16 @@ METRIC_NAMES: Dict[str, str] = {
         "requests whose prompt reused >= 1 cached prefix page "
         "(prompt caching; counter)"
     ),
+    # Speculative decoding (serving/speculative.py).
+    "serve_spec_accept_len": (
+        "per verify round per slot: tokens emitted (accepted draft "
+        "prefix + the correction/bonus token, so 1..k+1) — the "
+        "realized-speedup distribution"
+    ),
+    "serve_spec_tokens_total": (
+        "tokens emitted by speculative verify rounds (counter; subset "
+        "of serve_tokens_total)"
+    ),
     # Checkpointing (checkpointing/save.py + writer.py).
     "ckpt_snapshot_s": "device->host snapshot half of a sharded save",
     "ckpt_background_write_s": "file-I/O half, on the writer thread",
@@ -125,6 +135,14 @@ TRACE_EVENT_NAMES: Dict[str, str] = {
     "queued": "serving request leg: submit -> admission",
     "decode": "serving request leg: first token -> eviction",
     "batch_occupancy": "serving counter: active slots per decode step",
+    "draft_round": (
+        "serving: one speculative proposal round (k draft decode "
+        "steps over the active set, serving/speculative.py)"
+    ),
+    "verify_step": (
+        "serving: one speculative verify step (target scores k+1 "
+        "positions per slot in one chunk-shaped iteration)"
+    ),
 }
 
 

@@ -100,6 +100,25 @@ def chunk_stem(stem_params, ids, start, dtype):
     return h
 
 
+def verify_stem(stem_params, tokens, positions, dtype):
+    """Speculative verify stem: each slot's (T,) token span embedded at
+    ITS OWN positions `positions[s] + [0, T)` — the batched cousin of
+    `chunk_stem` (same clipped per-token position gathers; padding rows
+    past the table are discarded by the verify masks) crossed with
+    `decode_stem`'s per-slot raggedness. tokens (slots, T),
+    positions (slots,) -> h (slots, T, dim)."""
+    t = tokens.shape[1]
+    pos_ids = jnp.clip(
+        positions[:, None] + jnp.arange(t)[None, :],
+        0, stem_params["position"].shape[0] - 1,
+    )
+    h = jnp.take(stem_params["word"], tokens, axis=0) \
+        + jnp.take(stem_params["position"], pos_ids, axis=0)
+    if dtype is not None:
+        h = h.astype(dtype)
+    return h
+
+
 def prefill_stem(stem_params, ids, offset, dtype):
     """Prompt stem over (B, T) ids starting at global position `offset`
     (0 for the dense layouts; the shard's global offset under 'seq'
@@ -485,6 +504,107 @@ class PagedChunkAttention:
         )
 
 
+class PagedVerifyAttention:
+    """attention_fn for ONE speculative VERIFY step over the whole slot
+    batch (replicated/TP layouts): every slot's (k+1)-token span — its
+    current last token plus the k draft proposals — attends causally
+    over the slot's cached prefix PLUS the span itself, exactly the
+    `PagedChunkAttention` causal-over-cached-prefix machinery batched
+    over slots (each slot at its OWN start position, like
+    `PagedCacheAttention`'s ragged batch).
+
+    Writes are the chunk recorder's gather-select over the gathered
+    view (no dynamic-slice clamping near max_len), gated per slot on
+    `active`; scatter-back rewrites only the (T-1)//page + 2 pages each
+    slot's span can touch (a static count — unallocated entries and
+    inactive slots drop). The span lands in the cache BEFORE acceptance
+    is known: rejected suffix tokens are rolled back host-side by
+    truncating the block table (`PagedCacheHost.truncate`) — pages are
+    freed, never copied, and stale K/V inside the kept tail stays
+    masked by the slot's position like any recycled slot's."""
+
+    def __init__(self, k, v, block_table, positions, active,
+                 page_size: int):
+        self.k = k  # (layers, num_pages, page, H, Dh)
+        self.v = v
+        self.bt = block_table  # (slots, pages_per_slot) int32
+        self.positions = positions  # (slots,) span START position
+        self.active = active  # (slots,) bool
+        self.page = page_size
+        self.layer = 0
+
+    def _write_span(self, view, new):
+        """view (slots, view_len, H, Dh) <- new (slots, T, H, Dh) at
+        [pos_s, pos_s + T) per slot; inactive slots keep their view."""
+        t = new.shape[1]
+        g = jnp.arange(view.shape[1])  # (view,)
+        c = jnp.clip(g[None, :] - self.positions[:, None], 0, t - 1)
+        cand = jnp.take_along_axis(
+            new, c[:, :, None, None], axis=1
+        ).astype(view.dtype)  # (slots, view, H, Dh)
+        inside = (
+            (g[None, :] >= self.positions[:, None])
+            & (g[None, :] < self.positions[:, None] + t)
+            & self.active[:, None]
+        )
+        return jnp.where(inside[:, :, None, None], cand, view)
+
+    def _scatter_span(self, pool_layer, view, t: int):
+        """Write back each slot's touched pages — the span [pos, pos+t)
+        overlaps at most (t-1)//page + 2 slot-local pages (the
+        `PagedChunkAttention._scatter_touched` count, batched). A
+        trailing index past the real span rewrites a just-gathered page
+        with its own bytes; OOB / unallocated / inactive drop. Distinct
+        live slots write distinct pool pages (the host's copy-on-write
+        keeps write pages private), so the flattened scatter has no
+        duplicate indices."""
+        num_pages = pool_layer.shape[0]
+        s = view.shape[0]
+        pages = view.reshape(
+            s, -1, self.page, view.shape[-2], view.shape[-1]
+        )
+        n_touch = (t - 1) // self.page + 2
+        idx = (
+            self.positions[:, None] // self.page
+            + jnp.arange(n_touch)[None, :]
+        )  # (slots, n_touch) slot-local page indices
+        safe = jnp.clip(idx, 0, pages.shape[1] - 1)
+        touched = jnp.take_along_axis(
+            pages, safe[:, :, None, None, None], axis=1
+        )  # (slots, n_touch, page, H, Dh)
+        dst = jnp.take_along_axis(self.bt, safe, axis=1)
+        ok = (idx < pages.shape[1]) & (dst >= 0) \
+            & self.active[:, None]
+        dst = jnp.where(ok, dst, num_pages)  # OOB -> drop
+        return pool_layer.at[dst.reshape(-1)].set(
+            touched.reshape(-1, self.page, *view.shape[-2:]),
+            mode="drop",
+        )
+
+    def __call__(self, q, k_new, v_new, mask):
+        i = self.layer
+        self.layer += 1
+        t = k_new.shape[1]
+        kview = self._write_span(_gather_pages(self.k[i], self.bt), k_new)
+        vview = self._write_span(_gather_pages(self.v[i], self.bt), v_new)
+        self.k = self.k.at[i].set(self._scatter_span(self.k[i], kview, t))
+        self.v = self.v.at[i].set(self._scatter_span(self.v[i], vview, t))
+        # Causal across the prefix boundary, per slot: query token j of
+        # slot s sits at global position pos_s + j and sees every cached
+        # position <= pos_s + j — row 0 conditions on exactly the real
+        # prefix, row j on the prefix plus the first j span tokens, so
+        # accepted rows reproduce plain decode's logits position for
+        # position.
+        qpos = self.positions[:, None] + jnp.arange(t)[None, :]
+        valid = (
+            jnp.arange(kview.shape[1])[None, None, :]
+            <= qpos[:, :, None]
+        )  # (slots, Tq, view)
+        return dot_product_attention(
+            q, kview, vview, mask=valid[:, None]
+        )
+
+
 # ---------------------------------------- decode-time collective matmul
 
 
@@ -522,8 +642,8 @@ class DecodeCollectiveMatmul:
         size = self.mesh.shape[self.axis]
         if rows % size:
             raise ValueError(
-                f"decode collective_matmul rings over the slot batch: "
-                f"{rows} slots not divisible by the {size}-way "
+                f"decode collective_matmul rings over the slot-token "
+                f"batch: {rows} rows not divisible by the {size}-way "
                 f"'{self.axis}' axis"
             )
         if features % size:
@@ -533,10 +653,16 @@ class DecodeCollectiveMatmul:
             )
 
     def column(self, h, w, b):
-        """(slots, 1, D) -> (slots, 1, F) F-sharded; slots gathered via
-        the ag_matmul ring."""
-        slots = h.shape[0]
-        self._check(slots, w.shape[-1], "output features")
+        """(slots, T, D) -> (slots, T, F) F-sharded; the flattened
+        slots*T row batch gathered via the ag_matmul ring. T is 1 for a
+        decode step and k+1 for a speculative verify step — the SAME
+        ring either way (hop count depends only on the axis size), which
+        is the hlolint `spec-verify-step` contract: k extra tokens ride
+        the one chain, they never cost k chains. num_slots % S == 0
+        (the engine guard) keeps the flattened row count divisible for
+        every T."""
+        rows = h.shape[0] * h.shape[1]
+        self._check(rows, w.shape[-1], "output features")
         fn = shard_map(
             partial(
                 _decode_column, axis_name=self.axis,
@@ -548,18 +674,19 @@ class DecodeCollectiveMatmul:
             out_specs=P(None, self.axis),
             check_vma=False,
         )
-        # The named scope is the hlolint anchor: `serve-decode-ring`
-        # counts exactly these permutes (GSPMD's own resharding
-        # permutes around the regions stay untagged).
+        # The named scope is the hlolint anchor: `serve-decode-ring` /
+        # `spec-verify-step` count exactly these permutes (GSPMD's own
+        # resharding permutes around the regions stay untagged).
         with jax.named_scope("serve_ring"):
-            y = fn(h[:, 0, :], w, b)
-        return y[:, None, :]
+            y = fn(h.reshape(rows, h.shape[-1]), w, b)
+        return y.reshape(h.shape[0], h.shape[1], -1)
 
     def row(self, h, w, b):
-        """(slots, 1, F) F-sharded -> (slots, 1, D); partial sums
-        reduce-scattered onto the slot shards via the matmul_rs ring."""
-        slots = h.shape[0]
-        self._check(slots, w.shape[0], "input features")
+        """(slots, T, F) F-sharded -> (slots, T, D); partial sums
+        reduce-scattered onto the flattened slot-token row shards via
+        the matmul_rs ring (same T generalization as `column`)."""
+        rows = h.shape[0] * h.shape[1]
+        self._check(rows, w.shape[0], "input features")
         fn = shard_map(
             partial(
                 _decode_row, axis_name=self.axis,
@@ -571,8 +698,8 @@ class DecodeCollectiveMatmul:
             check_vma=False,
         )
         with jax.named_scope("serve_ring"):
-            y = fn(h[:, 0, :], w, b)
-        return y[:, None, :]
+            y = fn(h.reshape(rows, h.shape[-1]), w, b)
+        return y.reshape(h.shape[0], h.shape[1], -1)
 
 
 def _decode_column(hl, wl, bl, *, axis_name, mode=None):
@@ -604,11 +731,13 @@ __all__ = [
     "PagedCacheAttention",
     "PagedChunkAttention",
     "PagedSeqShardedCacheAttention",
+    "PagedVerifyAttention",
     "PrefillRecorder",
     "SeqShardedCacheAttention",
     "chunk_stem",
     "decode_ring_permutes",
     "decode_stem",
     "prefill_stem",
+    "verify_stem",
     "write_position",
 ]

@@ -73,11 +73,13 @@ from distributed_model_parallel_tpu.serving.decode import (
     PagedCacheAttention,
     PagedChunkAttention,
     PagedSeqShardedCacheAttention,
+    PagedVerifyAttention,
     PrefillRecorder,
     SeqShardedCacheAttention,
     chunk_stem,
     decode_stem,
     prefill_stem,
+    verify_stem,
 )
 from distributed_model_parallel_tpu.serving.kv_cache import (
     KVCacheSpec,
@@ -141,6 +143,14 @@ class ServingEngine:
     # host-side token-prefix map (copy-on-write on the first divergent
     # write). Requires page_size + prefill_chunk; replicated/tp.
     prefix_cache: bool = False
+    # Speculative decoding (serving/speculative.py — Leviathan ICML'23,
+    # PAPERS.md): a draft engine proposes this many tokens per slot per
+    # round and THIS engine scores all k+1 positions in one
+    # chunked-prefill-shaped verify step; rejected suffixes roll back
+    # by truncating the block table. 0 = off. Requires page_size (the
+    # rollback is a block-table edit) and a non-sp layout; pass the
+    # draft engine + params to `run`.
+    speculative_k: int = 0
 
     def __post_init__(self):
         cfg = self.cfg
@@ -238,6 +248,35 @@ class ServingEngine:
                         "ingestion mid-prompt, which only the chunked "
                         "path can do"
                     )
+        if self.speculative_k:
+            if not 1 <= self.speculative_k <= 8:
+                raise ValueError(
+                    f"speculative_k must be in [1, 8], got "
+                    f"{self.speculative_k} (the verify step scores "
+                    "k+1 positions in one compile; past ~8 the "
+                    "acceptance tail pays for nothing)"
+                )
+            if self.layout == "sp":
+                raise ValueError(
+                    "speculative_k is not supported under the sp "
+                    "layout: the verify step is a chunk-shaped batched "
+                    "write the 'seq'-sharded shard_map decode has no "
+                    "path for (same refusal shape as sp+int8) — use "
+                    "the replicated/tp layouts"
+                )
+            if self.page_size is None:
+                raise ValueError(
+                    "speculative_k rolls rejected draft tokens back by "
+                    "TRUNCATING THE BLOCK TABLE (freeing pages, never "
+                    "copying KV); it requires the paged layout — set "
+                    "page_size"
+                )
+            if self.speculative_k + 1 >= self.max_len:
+                raise ValueError(
+                    f"speculative_k {self.speculative_k} leaves no "
+                    f"room: a verify round writes k+1 positions into a "
+                    f"max_len={self.max_len} cache"
+                )
         if self.collective_matmul and self.layout != "tp":
             raise ValueError(
                 "collective_matmul=True rings decode projections over "
@@ -616,12 +655,39 @@ class ServingEngine:
             )
             return {"k": rec.k, "v": rec.v}, next_logits
 
+        # --- speculative verify: all slots' k+1-token spans, one step -
+        # The chunk-shaped twin of paged_decode_step: same recorder
+        # discipline (gather -> span write -> touched-page scatter),
+        # same ctx.matmul policy threading — under tp+cm the flattened
+        # slots*(k+1) rows ride the SAME 4·L·(S-1) serve_ring permute
+        # chain as one decode step (hlolint `spec-verify-step`).
+        spec_t = self.speculative_k + 1
+
+        def paged_verify_step(params, cache, bt, positions,
+                              tokens_chunk, active):
+            rec = PagedVerifyAttention(
+                cache["k"], cache["v"], bt, positions, active, page
+            )
+            h = verify_stem(
+                params["stem"], tokens_chunk, positions, cdt
+            )
+            mask = jnp.ones((num_slots, spec_t), jnp.bool_)
+            h = run_blocks(
+                params, (h, mask), rec,
+                dataclasses.replace(ctx, matmul=mm),
+            )
+            logits = head_apply(params["head"], h)  # (slots, k+1, V)
+            return {"k": rec.k, "v": rec.v}, logits
+
+        verify_fn = paged_verify_step if self.speculative_k else None
+
         donate = (1,) if self.donate else ()  # the cache argument
+        self.verify_step = None
         if paged is not None:
             self._jit_paged_steps(
                 paged_decode_step, sp_paged_decode_step,
                 paged_prefill_step, sp_paged_prefill_step,
-                chunk_prefill_step, donate,
+                chunk_prefill_step, verify_fn, donate,
             )
             return
         if self.layout == "sp":
@@ -679,13 +745,16 @@ class ServingEngine:
             )
 
     def _jit_paged_steps(self, decode_fn, sp_decode_fn, prefill_fn,
-                         sp_prefill_fn, chunk_fn, donate):
+                         sp_prefill_fn, chunk_fn, verify_fn, donate):
         """Compile the paged step set. Public surface:
 
         * `decode_step(params, cache, bt, positions, tokens, active)`
         * `prefill(params, cache, bt_row, ids, length)` — monolithic
         * `chunk_prefill(params, cache, bt_row, ids, start, n_valid)`
           (only when `prefill_chunk` is set)
+        * `verify_step(params, cache, bt, positions, tokens_chunk,
+          active)` — speculative k+1-position scoring (only when
+          `speculative_k` is set); logits (slots, k+1, vocab)
         * `_copy_page(cache, src, dst)` — the COW kernel
           `PagedCacheHost` calls
         """
@@ -753,6 +822,21 @@ class ServingEngine:
                     out_shardings=(self._paged_sh, r),
                     donate_argnums=donate,
                 )
+            if verify_fn is not None:
+                # Verify logits stay slot-sharded over 'model' under
+                # tp, like decode's — the host reads every row anyway.
+                vlogits_sh = (
+                    NamedSharding(self.mesh, P("model", None, None))
+                    if self.layout == "tp" else self._repl
+                )
+                self.verify_step = jax.jit(
+                    verify_fn,
+                    in_shardings=(
+                        self._param_sh, self._paged_sh, r, r, r, r,
+                    ),
+                    out_shardings=(self._paged_sh, vlogits_sh),
+                    donate_argnums=donate,
+                )
             return
         self.decode_step = jax.jit(decode_fn, donate_argnums=donate)
         self.prefill = jax.jit(prefill_fn, donate_argnums=donate)
@@ -761,6 +845,8 @@ class ServingEngine:
             self.chunk_prefill = jax.jit(
                 chunk_fn, donate_argnums=donate
             )
+        if verify_fn is not None:
+            self.verify_step = jax.jit(verify_fn, donate_argnums=donate)
 
     # ------------------------------------------------------------ state
 
@@ -836,16 +922,40 @@ class ServingEngine:
         )
 
     def run(self, params, requests: Sequence[Request],
-            sampling: Optional[SamplingConfig] = None) -> Scheduler:
+            sampling: Optional[SamplingConfig] = None, *,
+            draft: Optional["ServingEngine"] = None,
+            draft_params=None) -> Scheduler:
         """Offline continuous batching: drive the request set to
         completion (greedy decoding by default; pass a SamplingConfig
         for temperature/top-k/top-p with per-slot PRNG lanes),
         returning the Scheduler with its per-request `finished` records
-        and `latency_report()`."""
+        and `latency_report()`. With `speculative_k` set, pass the
+        draft engine and its params — the loop moves to
+        `serving/speculative.run_speculative` (draft-propose, one-pass
+        verify, lossless accept)."""
         sampler = (
             SlotSampler(sampling, self.num_slots)
             if sampling is not None and not sampling.greedy else None
         )
+        if self.speculative_k:
+            if draft is None or draft_params is None:
+                raise ValueError(
+                    "speculative_k > 0 needs a proposer: pass "
+                    "run(..., draft=<draft ServingEngine>, "
+                    "draft_params=<its params>)"
+                )
+            from distributed_model_parallel_tpu.serving.speculative import (  # noqa: E501
+                run_speculative,
+            )
+
+            return run_speculative(
+                self, params, requests, sampler, draft, draft_params
+            )
+        if draft is not None or draft_params is not None:
+            raise ValueError(
+                "draft/draft_params drive speculative decoding; set "
+                "speculative_k > 0 on the target engine as well"
+            )
         if self.paged_spec is not None:
             return self._run_paged(params, requests, sampler)
         return self._run_contiguous(params, requests, sampler)

@@ -676,6 +676,23 @@ class PagedCacheHost:
         if all(p >= 0 for p in ids):
             self.prefix.register(prompt, ids)
 
+    def truncate(self, slot: int, n_tokens: int) -> None:
+        """Roll a slot back so it holds only its first `n_tokens`
+        positions: pages wholly beyond the kept span return to the pool
+        (shared pages just drop this slot's reference). THE speculative
+        rollback primitive — a rejected draft suffix is a block-table
+        edit plus refcount decrements, never a KV copy. Stale K/V
+        inside the kept final page's tail stays masked by the slot's
+        position until overwritten, the same discipline recycled slots
+        rely on."""
+        keep = self._pages_for(n_tokens)
+        for j in range(keep, self.spec.pages_per_slot):
+            pid = int(self.block_tables[slot, j])
+            if pid >= 0:
+                self.pool.decref(pid)
+                self.block_tables[slot, j] = -1
+                self._dev_table = None
+
     def release(self, slot: int) -> None:
         """Recycle a slot: PAGES return to the pool (minus surviving
         shared references) — never a max_len stripe — and its

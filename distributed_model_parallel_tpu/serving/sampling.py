@@ -102,5 +102,55 @@ class SlotSampler:
         idx = int(np.searchsorted(np.cumsum(probs), draw, side="right"))
         return int(order[min(idx, len(order) - 1)])
 
+    # ------------------------------------------- speculative surface
+    # The lossless rejection rule (serving/speculative.py, Leviathan
+    # ICML'23) needs the FULL filtered distributions of both models and
+    # raw lane uniforms, not just a draw — `dist` is `pick`'s filter
+    # pipeline factored out (same temperature/top-k/top-p order), and
+    # `uniform`/`sample_dist` consume the SAME per-slot Philox lane, so
+    # a slot's draws still depend only on how many numbers IT drew.
+
+    def dist(self, logits: np.ndarray) -> np.ndarray:
+        """The filtered, renormalized distribution `pick` samples from,
+        as a dense vocab-length float64 vector (zero outside the kept
+        set). Pure — never touches a lane."""
+        cfg = self.cfg
+        if cfg.greedy:
+            raise ValueError(
+                "greedy decoding (temperature 0) has no sampling "
+                "distribution — the speculative greedy path compares "
+                "argmaxes instead"
+            )
+        z = np.asarray(logits, np.float64) / cfg.temperature
+        order = np.argsort(z)[::-1]
+        if cfg.top_k:
+            order = order[: cfg.top_k]
+        zk = z[order]
+        probs = np.exp(zk - zk.max())
+        probs /= probs.sum()
+        if cfg.top_p < 1:
+            keep = int(np.searchsorted(
+                np.cumsum(probs), cfg.top_p, side="left"
+            )) + 1
+            order = order[:keep]
+            probs = probs[:keep] / probs[:keep].sum()
+        out = np.zeros(np.asarray(logits).shape[-1], np.float64)
+        out[order] = probs
+        return out
+
+    def uniform(self, slot: int) -> float:
+        """One U[0,1) draw from the slot's lane (the accept/reject
+        coin)."""
+        return float(self._lanes[slot].random())
+
+    def sample_dist(self, dist: np.ndarray, slot: int) -> int:
+        """Inverse-CDF draw from a dense distribution on the slot's
+        lane (the residual-distribution draw after a rejection, and the
+        bonus-token draw after a full accept)."""
+        cdf = np.cumsum(np.asarray(dist, np.float64))
+        u = self._lanes[slot].random() * cdf[-1]
+        idx = int(np.searchsorted(cdf, u, side="right"))
+        return int(min(idx, len(cdf) - 1))
+
 
 __all__ = ["SamplingConfig", "SlotSampler"]

@@ -120,6 +120,13 @@ class Scheduler:
         # page-pool accounting and prefix-cache hit stats.
         self.paged_stats: Optional[dict] = None
         self.prefix_stats: Optional[dict] = None
+        # Attached by the speculative loop (serving/speculative.py):
+        # per-slot emitted-token count of every verify round (1..k+1
+        # each — accepted draft prefix + correction/bonus token) and
+        # the configured draft length k. Feeds the `speculative`
+        # section of latency_report.
+        self.spec_accept_lens: List[int] = []
+        self.spec_k: Optional[int] = None
 
     # ------------------------------------------------------- lifecycle
 
@@ -207,6 +214,30 @@ class Scheduler:
             mx.gauge("serve_batch_occupancy", int(n_active))
             mx.inc("serve_tokens_total", int(n_active))
 
+    def record_verify_step(self, n_active: int, n_tokens: int) -> None:
+        """One speculative verify step: `n_active` slots verified a
+        draft block and emitted `n_tokens` tokens between them (1..k+1
+        per slot). Occupancy samples stay per-STEP (the goodput
+        denominator is slot-steps, and a verify step occupies a slot
+        exactly like a decode step); the token counter advances by the
+        tokens actually emitted."""
+        self.step_occupancy.append(int(n_active))
+        mx = get_metrics()
+        if mx.enabled:
+            mx.gauge("serve_batch_occupancy", int(n_active))
+            mx.inc("serve_tokens_total", int(n_tokens))
+
+    def record_accept_len(self, n_emitted: int) -> None:
+        """One slot's emitted-token count for one verify round
+        (accepted draft prefix + the correction/bonus token): the
+        acceptance-length histogram obsreport turns into realized
+        speedup."""
+        self.spec_accept_lens.append(int(n_emitted))
+        mx = get_metrics()
+        if mx.enabled:
+            mx.observe("serve_spec_accept_len", float(n_emitted))
+            mx.inc("serve_spec_tokens_total", int(n_emitted))
+
     def record_iteration(self, n_useful: int) -> None:
         """One engine iteration's useful-slot count (decoding slots +
         slots that ingested prefill work this iteration) — the
@@ -271,6 +302,22 @@ class Scheduler:
             out["paged"] = dict(self.paged_stats)
         if self.prefix_stats is not None:
             out["prefix_cache"] = dict(self.prefix_stats)
+        if self.spec_accept_lens:
+            lens = np.asarray(self.spec_accept_lens, np.float64)
+            k = self.spec_k or 0
+            # Emitted = accepted drafts + one guaranteed correction/
+            # bonus token per round, so accept_rate strips the
+            # guaranteed token before dividing by the k drafts offered.
+            drafted = lens.size * max(k, 1)
+            out["speculative"] = {
+                "k": k,
+                "verify_rounds": int(lens.size),
+                "mean_accept_len": round(float(lens.mean()), 3),
+                "accept_rate": round(
+                    float((lens - 1.0).sum()) / drafted, 4
+                ),
+                "spec_tokens": int(lens.sum()),
+            }
         return out
 
 

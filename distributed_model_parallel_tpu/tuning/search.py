@@ -205,21 +205,38 @@ def serve_closed_form_s(knobs: dict, payload: dict,
     chunk-padding vs chunk-launch tradeoffs, ISSUE 15) plus the
     compute-dtype roofline term over the request's decode steps
     (ISSUE 16; priced under the hand MXU/HBM constants — the comm
-    `constants` dict is the calibratable set, compute is not)."""
+    `constants` dict is the calibratable set, compute is not).
+    Speculative candidates (ISSUE 18) dispatch to
+    `cost.serve_speculative_request_s`: the decode loop collapses to
+    new_tokens / E verify rounds plus the draft's amortized share."""
     from distributed_model_parallel_tpu.observability import cost
 
+    mode = knobs.get("compute_dtype") or "f32"
+    spec_k = knobs.get("speculative_k") or 0
+    decode_c = cost.serve_decode_compute_s(
+        layers=2, dim=16, ffn_dim=32, n_slots=payload["n_slots"],
+        mode=mode, shards=payload.get("shards", 1),
+    )
+    if spec_k:
+        return cost.serve_speculative_request_s(
+            payload["prompt_tokens"], payload["new_tokens"],
+            payload["token_bytes"], knobs["page_size"],
+            knobs["prefill_chunk"], spec_k,
+            decode_compute_s=decode_c,
+            verify_compute_s=cost.serve_verify_compute_s(
+                layers=2, dim=16, ffn_dim=32,
+                n_slots=payload["n_slots"], speculative_k=spec_k,
+                mode=mode, shards=payload.get("shards", 1),
+            ),
+            constants=constants,
+        )
     comm = cost.serve_paged_request_s(
         payload["live_tokens"], payload["prompt_tokens"],
         payload["new_tokens"], payload["token_bytes"],
         knobs["page_size"], knobs["prefill_chunk"],
         constants=constants,
     )
-    compute = payload["new_tokens"] * cost.serve_decode_compute_s(
-        layers=2, dim=16, ffn_dim=32, n_slots=payload["n_slots"],
-        mode=knobs.get("compute_dtype") or "f32",
-        shards=payload.get("shards", 1),
-    )
-    return comm + compute
+    return comm + payload["new_tokens"] * decode_c
 
 
 def closed_form_step_s(family: str, knobs: dict, payload: dict,
@@ -313,6 +330,9 @@ def candidate_combo(cell: Cell, knobs: dict):
             page_size=knobs["page_size"],
             prefill_chunk=knobs["prefill_chunk"],
             compute_dtype=None if mode == "f32" else mode,
+            # k > 0 lowers (and prices) the VERIFY step; 0 keeps
+            # pre-ISSUE-18 combo names byte-stable.
+            speculative_k=knobs.get("speculative_k") or 0,
         )
     raise ValueError(f"no combo mapping for family {cell.family!r}")
 

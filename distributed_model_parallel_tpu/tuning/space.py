@@ -89,12 +89,21 @@ SPACES: Dict[str, Tuple[Knob, ...]] = {
     # compute_dtype (ISSUE 16): the decode projection GEMM arithmetic
     # (`ops/quant_matmul.py`), priced by the MXU/HBM roofline closed
     # form (`cost.serve_decode_compute_s`).
+    # speculative_k (ISSUE 18, `serving/speculative.py`): draft
+    # lookahead depth — 0 is plain decode; k >= 1 trades k draft steps
+    # (at DRAFT_COST_RATIO of a target step) for one k+1-wide verify
+    # step whose weight stream costs the same as ONE decode step,
+    # amortized over the expected accepted tokens
+    # (`cost.serve_speculative_request_s`). Every serve grid point is
+    # paged, so the rollback-by-block-table requirement always holds.
     "serve": (
         Knob("page_size", (4, 8, 16), "--page-size", "page_size"),
         Knob("prefill_chunk", (4, 8, 16), "--prefill-chunk",
              "prefill_chunk"),
         Knob("compute_dtype", ("f32", "bf16", "int8"),
              "--compute-dtype", "compute_dtype"),
+        Knob("speculative_k", (0, 2, 4), "--speculative-k",
+             "speculative_k"),
     ),
 }
 
@@ -162,12 +171,15 @@ def preference(family: str, knobs: dict) -> tuple:
         # Equal-cost ties break toward less HBM overscan (smaller
         # pages), then fewer ingest launches (larger chunks), then the
         # LESS exotic arithmetic (quantization the roofline doesn't
-        # pay for is free numerics risk — mirrors the wire tie-break).
+        # pay for is free numerics risk — mirrors the wire tie-break),
+        # then the SHALLOWER lookahead (a draft model the amortization
+        # doesn't pay for is free machinery).
         return (
             knobs["page_size"], -knobs["prefill_chunk"],
             ("f32", "bf16", "int8").index(
                 knobs.get("compute_dtype") or "f32"
             ),
+            knobs.get("speculative_k") or 0,
         )
     # tp: prefer the ring decomposition on a tie (latency hiding).
     return (0 if knobs["collective_matmul"] else 1,)

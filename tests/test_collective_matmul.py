@@ -175,10 +175,12 @@ def _assert_state_close(ts_a, ts_b, rtol=1e-5, atol=1e-6):
         )
 
 
-# S=8 rides slow (tier-1 budget): S=4 already exercises multi-hop rings
-# and the S-sweep's 8-way case runs in the full suite.
+# S=4 and S=8 ride slow (tier-1 budget): S=2 pins the rotate/overlap
+# algebra on the same code path, and the multi-hop cases (4, 8) run in
+# the full suite. Tier-1 twin of both: the S=2 case.
 @pytest.mark.parametrize(
-    "tp", [2, 4, pytest.param(8, marks=pytest.mark.slow)]
+    "tp", [2, pytest.param(4, marks=pytest.mark.slow),
+           pytest.param(8, marks=pytest.mark.slow)]
 )
 def test_tp_collective_matmul_matches_declarative(tp):
     """TensorParallelEngine(collective_matmul=True) == the declarative
@@ -243,9 +245,11 @@ def test_tp_collective_matmul_rejects_indivisible_seq():
 # ------------------------------------------------- SP engine parity
 
 
-# S=8 rides slow (tier-1 budget), same rationale as the TP sweep above.
+# S=4 and S=8 ride slow (tier-1 budget), same rationale and twin as
+# the TP sweep above.
 @pytest.mark.parametrize(
-    "sp", [2, 4, pytest.param(8, marks=pytest.mark.slow)]
+    "sp", [2, pytest.param(4, marks=pytest.mark.slow),
+           pytest.param(8, marks=pytest.mark.slow)]
 )
 def test_sp_collective_matmul_matches_ring_engine(sp):
     """SequenceParallelEngine(collective_matmul=True) == the plain ring

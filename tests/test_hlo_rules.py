@@ -325,6 +325,75 @@ def test_serve_decode_ring_missing_expectation_is_a_finding():
     assert found and "was not checked" in found[0].message
 
 
+# --------------------------------------------------- spec-verify-step
+
+
+def spec_target(**kw):
+    base = dict(
+        name="t", engine="serve", collective_matmul=True,
+        data_axes=(), ici_axis=None, ici_size=1,
+        cm_axis="model", cm_size=4, speculative_k=2,
+        spec_verify_permutes=2,
+    )
+    base.update(kw)
+    return LintTarget(**base)
+
+
+@pytest.mark.hlo_rule("spec-verify-step", "positive")
+def test_spec_verify_step_fires_on_k_scaled_rings_and_gather():
+    # A verify step whose ring count scaled with the chunk (3 tagged
+    # permutes where ONE decode step's 2 are pinned) plus a surviving
+    # monolithic all-gather over the TP axis: both findings fire.
+    hlo = module([
+        perm("cp0", "p", M4_PAIRS, tag="serve_ring"),
+        perm("cp1", "cp0", M4_PAIRS, tag="serve_ring"),
+        perm("cp2", "cp1", M4_PAIRS, tag="serve_ring"),
+        "%ag = f32[64]{0} all-gather(f32[64]{0} %p), "
+        "replica_groups={{0,1,2,3}}, dimensions={0}, "
+        "use_global_device_ids=true",
+    ])
+    found = check("spec-verify-step", spec_target(), hlo, MESH_M4)
+    msgs = "; ".join(f.message for f in found)
+    assert "expected exactly 2" in msgs
+    assert "independent of k=2" in msgs
+    assert "monolithic all-gather" in msgs
+
+
+@pytest.mark.hlo_rule("spec-verify-step", "negative")
+def test_spec_verify_step_decode_inventory_is_clean():
+    # Exactly one decode step's tagged rings; an UNTAGGED permute
+    # (GSPMD's own resharding traffic) must not count against the pin.
+    hlo = module([
+        perm("cp0", "p", M4_PAIRS, tag="serve_ring"),
+        perm("cp1", "cp0", M4_PAIRS, tag="serve_ring"),
+        perm("cp2", "cp1", M4_PAIRS),
+    ])
+    assert check(
+        "spec-verify-step", spec_target(), hlo, MESH_M4
+    ) == []
+
+
+def test_spec_verify_step_missing_expectation_is_a_finding():
+    """A speculative combo whose builder forgot the verify-ring
+    expectation must surface, not silently pass."""
+    hlo = module([perm("cp0", "p", M4_PAIRS, tag="serve_ring")])
+    found = check(
+        "spec-verify-step",
+        spec_target(spec_verify_permutes=None), hlo, MESH_M4,
+    )
+    assert found and "was not checked" in found[0].message
+
+
+def test_spec_verify_step_and_decode_ring_never_double_fire():
+    """A speculative target is judged by spec-verify-step only: the
+    decode-ring pin defers (its expectation describes the decode
+    step's HLO, and a speculative combo lowers the verify step)."""
+    assert REGISTRY["spec-verify-step"].applies(spec_target())
+    assert not REGISTRY["serve-decode-ring"].applies(spec_target())
+    assert REGISTRY["serve-decode-ring"].applies(serve_target())
+    assert not REGISTRY["spec-verify-step"].applies(serve_target())
+
+
 # --------------------------------------------------- fsdp-at-rest-sharded
 
 

@@ -56,6 +56,11 @@ TIER1_COMBOS = [
     # projection dot is s8 x s8 inside the cm rings, head stays f32
     # (the pre-gate twin)
     Combo("serve", 2, collective_matmul=True, compute_dtype="int8"),
+    # speculative verify step (spec-verify-step): the one-pass k+1
+    # verify carries exactly ONE decode step's tagged ring inventory,
+    # no monolithic gather (the pre-gate twin, ISSUE 18)
+    Combo("serve", 2, page_size=8, collective_matmul=True,
+          speculative_k=2),
 ]
 
 

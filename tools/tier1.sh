@@ -61,12 +61,14 @@ echo "[tier1] collection ok:" \
 # hlolint pre-gate (mirrors the --collect-only pre-gate): lint the
 # deepest-rule-stack combos (tinycnn DDP + FSDP overlapped — rings,
 # overlap deps, BN allowlist, at-rest sharding — plus the tinycnn-sized
-# hierarchical-MoE dispatch combo and the tinycnn-sized quantized-dcn
-# combo, so a broken wire codec fails with dcn-compressed-payload
-# named) BEFORE the suite, so a broken collective contract fails in
-# seconds with the violated rule NAMED instead of as a slow
-# structural-test failure mid-run. Exit 3 distinguishes a contract
-# violation from a collection failure (2).
+# hierarchical-MoE dispatch combo, the tinycnn-sized quantized-dcn
+# combo so a broken wire codec fails with dcn-compressed-payload
+# named, and the speculative paged+ringed serve combo so a verify step
+# that falls off the rings fails with spec-verify-step named) BEFORE
+# the suite, so a broken collective contract fails in seconds with the
+# violated rule NAMED instead of as a slow structural-test failure
+# mid-run. Exit 3 distinguishes a contract violation from a collection
+# failure (2).
 rm -f /tmp/_t1_hlolint.log
 if ! timeout -k 5 300 bash tools/hlolint --pregate \
     > /tmp/_t1_hlolint.log 2>&1; then
