@@ -693,6 +693,137 @@ def _tuned_row(axis_size: int, knobs, combo, tuned_ms: float,
     }
 
 
+def run_child_plan_bench(max_devices: int, platform: str = "cpu",
+                         plan_path=None) -> None:
+    """Composed-ParallelPlan microbench (parallel/plan.py, ISSUE 19):
+    one tiny-GPT train step per mesh factorization of the device
+    world — the pure-data plan (the table's default leg) against the
+    pp2/sp2 composed factorizations, the SAME spec strings the
+    training CLI's `--plan` takes, all through build_plan_engine.
+    Every row carries the alpha-beta prediction for ITS factorization
+    (`cost.composed_plan_step_s` — wire + seq-ring + fused-psum legs)
+    and, when the committed ledger has the matching plan/S combo, the
+    ledger column + drift delta. Emits one partial JSON line per
+    completed spec (a wedge mid-sweep keeps the finished legs), then
+    the table. `--plan PLAN.json` (a plan-family tuner artifact,
+    `--plan auto --auto-tune search`'s output) adds the tuned row
+    with tuned_vs_default_pct against the pure-data leg."""
+    if max_devices < 4:
+        raise ValueError(
+            f"--max-devices must be >= 4 for a composed plan, "
+            f"got {max_devices}"
+        )
+    if platform == "cpu":
+        from distributed_model_parallel_tpu.runtime.platform import force_cpu
+
+        force_cpu(max_devices)
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from distributed_model_parallel_tpu.models.gpt import GPTConfig
+    from distributed_model_parallel_tpu.observability import cost
+    from distributed_model_parallel_tpu.parallel.plan import (
+        build_plan_engine,
+        parse_plan,
+    )
+    from distributed_model_parallel_tpu.training.optim import SGD
+
+    knobs, combo = _bench_plan(plan_path, ("plan",), "composed-plan")
+
+    devices = jax.devices("cpu") if platform == "cpu" else jax.devices()
+    size = 1
+    while size * 2 <= min(max_devices, len(devices)):
+        size *= 2
+    if size < 4:
+        raise ValueError(
+            f"composed plans need >= 4 devices, {len(devices)} present"
+        )
+
+    cfg = GPTConfig(
+        vocab_size=61, dim=16, num_layers=4, num_heads=2, ffn_dim=32,
+        max_position=16, dropout_rate=0.0,
+    )
+    batch = 2 * size  # divides dp*M for every factorization below
+    rng = np.random.RandomState(0)
+    ids = rng.randint(1, 61, size=(batch, 16)).astype(np.int32)
+
+    def _time_spec(spec: str) -> dict:
+        plan = parse_plan(spec)
+        engine = build_plan_engine(
+            cfg, SGD(), plan, devices=devices[:size], donate=False,
+        )
+        state = engine.init_state(jax.random.PRNGKey(0))
+        sids, stg = engine.shard_batch(ids)
+        lr = jnp.float32(0.05)
+        for _ in range(2):
+            state, _ = engine.train_step(state, sids, stg, lr)
+        _sync(state)
+        iters = 10
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            state, _ = engine.train_step(state, sids, stg, lr)
+        _sync(state)
+        step_ms = (time.perf_counter() - t0) / iters * 1e3
+        grad_bytes = 4 * sum(
+            int(np.prod(l.shape))
+            for l in jax.tree_util.tree_leaves(
+                engine.to_canonical(state.params)
+            )
+        )
+        mb = batch // (plan.dp * plan.pp)  # rows per microbatch
+        pred_s = cost.composed_plan_step_s(
+            plan.pp, plan.tp_or_sp, plan.dp, grad_bytes, mb=mb,
+            seq_len=16, dim=cfg.dim, vocab=cfg.vocab_size,
+            n_layers=cfg.num_layers, ici=size, dcn=1,
+            fsdp=plan.fsdp,
+        )
+        return _with_predicted(
+            {
+                "plan": spec,
+                "axes": {"pp": plan.pp, "sp": plan.tp_or_sp,
+                         "dp": plan.dp, "fsdp": plan.fsdp},
+                "step_ms": round(step_ms, 3),
+                "model_predicted_ms": round(pred_s * 1e3, 4),
+            },
+            f"plan/S{size}/{spec}", measured_key="step_ms",
+        )
+
+    specs = [
+        f"dp{size}", f"pp2xdp{size // 2}", f"sp2xdp{size // 2}",
+        f"pp2xsp2xdp{size // 4}",
+    ]
+    rows = []
+    for spec in specs:
+        rows.append(_time_spec(spec))
+        # Per-leg partial line (same convention as the other sweeps):
+        # a wedge mid-sweep keeps the finished factorizations.
+        print(json.dumps({"leg": rows[-1], "partial": True}), flush=True)
+    out = {
+        "plan_microbench": rows,
+        "run_meta": _run_meta(platform=jax.devices()[0].platform),
+    }
+    if knobs is not None:
+        default = rows[0]  # the pure-data leg
+        tuned = _time_spec(knobs["plan"])
+        out["tuned"] = _tuned_row(
+            size, knobs, combo, tuned["step_ms"],
+            default["step_ms"], default["plan"],
+        )
+        print(json.dumps({"leg": out["tuned"], "partial": True}),
+              flush=True)
+    if jax.devices()[0].platform == "cpu":
+        out["note"] = (
+            "virtual CPU devices share one host core: the composed "
+            "factorizations serialize their stage/seq collectives onto "
+            "it, so step_ms ranks plans only on a real slice; "
+            "model_predicted_ms is the alpha-beta TPU-fabric prediction "
+            "the tuner ranks with"
+        )
+    print(json.dumps(out, indent=2))
+
+
 def run_child_cm(max_devices: int, platform: str = "cpu",
                  plan_path=None) -> None:
     """Naive-vs-overlapped collective-matmul microbench — the pjit
@@ -2514,12 +2645,23 @@ if __name__ == "__main__":
              "--max-devices",
     )
     parser.add_argument(
+        "--plan-microbench", action="store_true",
+        help="print a composed-ParallelPlan table (one tiny-GPT train "
+             "step per mesh factorization — pure-data vs pp2/sp2 "
+             "composed specs through build_plan_engine, "
+             "parallel/plan.py — with the alpha-beta "
+             "composed_plan_step_s prediction per row) instead of the "
+             "single benchmark line; devices from --scaling-platform "
+             "/ --max-devices",
+    )
+    parser.add_argument(
         "--plan", default=None, metavar="PLAN.json",
         help="time a tuner plan's chosen configuration "
              "(tuning/plan.py, --auto-tune search's artifact) as an "
              "extra row on the --reducer-microbench / --cm-microbench "
-             "/ --moe-microbench tables, with a tuned_vs_default_pct "
-             "column against the table's default-knob leg",
+             "/ --moe-microbench / --plan-microbench tables, with a "
+             "tuned_vs_default_pct column against the table's "
+             "default-knob leg",
     )
     parser.add_argument(
         "--child", action="store_true",
@@ -2540,6 +2682,9 @@ if __name__ == "__main__":
     parser.add_argument("--child-moe", action="store_true",
                         help="internal: run the MoE dispatch "
                              "microbench in-process")
+    parser.add_argument("--child-plan-bench", action="store_true",
+                        help="internal: run the composed-plan "
+                             "microbench in-process")
     parser.add_argument("--child-serving", action="store_true",
                         help="internal: run the serving microbench "
                              "in-process")
@@ -2558,24 +2703,25 @@ if __name__ == "__main__":
     n_sweeps = sum(
         (args.scaling, args.cm_microbench, args.reducer_microbench,
          args.moe_microbench, args.serving_microbench,
-         args.checkpoint_microbench)
+         args.checkpoint_microbench, args.plan_microbench)
     )
     if n_sweeps > 1:
         parser.error(
             "--scaling / --cm-microbench / --reducer-microbench / "
             "--moe-microbench / --serving-microbench / "
-            "--checkpoint-microbench are mutually exclusive (one sweep "
-            "per invocation; running several would silently drop "
-            "tables)"
+            "--checkpoint-microbench / --plan-microbench are mutually "
+            "exclusive (one sweep per invocation; running several "
+            "would silently drop tables)"
         )
     if args.plan and not (
         args.reducer_microbench or args.cm_microbench
-        or args.moe_microbench
+        or args.moe_microbench or args.plan_microbench
     ):
         parser.error(
-            "--plan adds a tuned row to the reducer/cm/moe "
+            "--plan adds a tuned row to the reducer/cm/moe/plan "
             "microbenches; pass one of --reducer-microbench / "
-            "--cm-microbench / --moe-microbench with it"
+            "--cm-microbench / --moe-microbench / --plan-microbench "
+            "with it"
         )
     if args.plan and not os.path.isfile(args.plan):
         parser.error(f"--plan: no such file {args.plan!r}")
@@ -2602,6 +2748,10 @@ if __name__ == "__main__":
     if args.child_moe:
         run_child_moe(args.max_devices, args.scaling_platform,
                       args.child_plan)
+        sys.exit(0)
+    if args.child_plan_bench:
+        run_child_plan_bench(args.max_devices, args.scaling_platform,
+                             args.child_plan)
         sys.exit(0)
     if args.child_serving:
         run_child_serving(args.max_devices, args.scaling_platform)
@@ -2668,6 +2818,15 @@ if __name__ == "__main__":
                      "--max-devices", str(args.max_devices),
                      "--scaling-platform", args.scaling_platform],
                     env, "serving_microbench",
+                )
+            elif args.plan_microbench:
+                _run_sweep_child(
+                    ["--child-plan-bench",
+                     "--max-devices", str(args.max_devices),
+                     "--scaling-platform", args.scaling_platform]
+                    + (["--child-plan", args.plan] if args.plan
+                       else []),
+                    env, "plan_microbench",
                 )
             else:
                 _run_sweep_child(

@@ -92,6 +92,12 @@ class Combo:
     # truncates the block table).
     speculative_k: int = 0
 
+    # Composed ParallelPlan spec (engine == "plan", ISSUE 19): the
+    # `parse_plan` spec string (e.g. "pp2xsp2xdp2") the builder lowers
+    # through ComposedPlanEngine. None everywhere else (every
+    # pre-existing combo name and ledger row stays byte-stable).
+    plan: Optional[str] = None
+
     @property
     def name(self) -> str:
         bits = [self.engine, f"S{self.size}"]
@@ -103,6 +109,8 @@ class Combo:
             bits.append(self.moe_dispatch)
             if self.moe_overlap:
                 bits.append("ov")
+        if self.plan is not None:
+            bits.append(self.plan)
         if self.dcn_compression != "none":
             bits.append(f"wire-{self.dcn_compression}")
         if self.bucket_mb is not None:
@@ -395,6 +403,86 @@ def jaxpr_ppermute_records(fn, *args):
                     str(eqn.source_info.name_stack),
                     int(_math.prod(aval.shape)) if aval.shape else 1,
                 ))
+            for v in eqn.params.values():
+                for sub in _subjaxprs(v):
+                    walk(sub)
+
+    def _subjaxprs(v):
+        import jax.core as core
+
+        if isinstance(v, core.ClosedJaxpr):
+            yield v.jaxpr
+        elif isinstance(v, core.Jaxpr):
+            yield v
+        elif isinstance(v, (tuple, list)):
+            for x in v:
+                yield from _subjaxprs(x)
+
+    walk(closed.jaxpr)
+    return tuple(out)
+
+
+# Named-axis collectives the plan fabric rules read, with the eqn
+# param their axis names live under (ppermute-family primitives carry
+# `axis_name`; the reduction family carries `axes`, possibly mixed
+# with positional ints which are not named-axis traffic).
+_COLLECTIVE_AXIS_PARAM = {
+    "ppermute": "axis_name",
+    "all_gather": "axis_name",
+    "all_to_all": "axis_name",
+    "reduce_scatter": "axis_name",
+    "psum": "axes",
+    "pmax": "axes",
+    "pmin": "axes",
+}
+
+
+def jaxpr_collective_records(fn, *args):
+    """((primitive, axis_names, dtype_token, scope, n_elems), ...) for
+    every named-axis collective equation in fn's jaxpr, sub-jaxprs
+    included — the multi-primitive generalization of
+    `jaxpr_ppermute_records` the composed-plan fabric rules read
+    (`LintTarget.plan_collective_records`): compiled HLO flattens axis
+    names to replica groups and normalizes dtypes, so an axis->fabric
+    contract must be pinned at trace level. Positional (int) axes are
+    dropped from the record — they are intra-shard reductions, not
+    fabric traffic."""
+    import math as _math
+
+    import jax
+
+    closed = jax.make_jaxpr(fn)(*args)
+    out = []
+    seen = set()
+
+    def walk(jaxpr):
+        if id(jaxpr) in seen:
+            return
+        seen.add(id(jaxpr))
+        for eqn in jaxpr.eqns:
+            key = _COLLECTIVE_AXIS_PARAM.get(eqn.primitive.name)
+            if key is not None:
+                axes = eqn.params.get(key)
+                axes = axes if isinstance(axes, tuple) else (axes,)
+                names = tuple(
+                    str(a) for a in axes if isinstance(a, str)
+                )
+                if names:
+                    aval = eqn.invars[0].aval
+                    dt = str(aval.dtype)
+                    n_elems = sum(
+                        int(_math.prod(v.aval.shape))
+                        if v.aval.shape else 1
+                        for v in eqn.invars
+                        if hasattr(v.aval, "shape")
+                    )
+                    out.append((
+                        eqn.primitive.name,
+                        names,
+                        _DTYPE_TOKEN.get(dt, dt),
+                        str(eqn.source_info.name_stack),
+                        n_elems,
+                    ))
             for v in eqn.params.values():
                 for sub in _subjaxprs(v):
                     walk(sub)
@@ -1065,6 +1153,72 @@ def _build_serve(combo: Combo, devices):
     return target, hlo, mesh
 
 
+def _build_plan(combo: Combo, devices):
+    """Composed-ParallelPlan train steps (`parallel/plan.py`, ISSUE
+    19) on the stage-major ('stage', 'data', 'seq') plan mesh. The
+    three plan-* fabric rules read `plan_collective_records` — the
+    trace-level inventory from `jaxpr_collective_records` — because
+    every contract here is a named-axis one: the plan_wire ppermute
+    rides ('stage',), the kv_ring/cm rings ride ('seq',), and the
+    fused plan_grad psum spans all three axes in one rendezvous."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from distributed_model_parallel_tpu.parallel.plan import (
+        ComposedPlanEngine, parse_plan,
+    )
+    from distributed_model_parallel_tpu.runtime.mesh import (
+        make_plan_mesh,
+    )
+    from distributed_model_parallel_tpu.training.optim import SGD
+
+    plan = parse_plan(combo.plan)
+    if plan.num_devices != combo.size:
+        raise ValueError(
+            f"combo size {combo.size} != plan {plan.spec!r} device "
+            f"count {plan.num_devices}"
+        )
+    mesh = make_plan_mesh(
+        plan.pp, plan.dp, plan.tp_or_sp,
+        devices=devices[: plan.num_devices],
+    )
+    cfg = _gpt_cfg()
+    if cfg.num_layers % plan.pp:
+        # Deep-pipeline specs (pp8 at S8) need a stage-divisible stack;
+        # widen the proxy to pp layers — the same proxy-fits-the-grid
+        # compromise as space._BUCKET_GRID's sub-MB values.
+        import dataclasses as _dc
+
+        cfg = _dc.replace(cfg, num_layers=plan.pp)
+    eng = ComposedPlanEngine(
+        cfg, SGD(), mesh, plan, min_shard_elems=64
+    )
+    ts = eng.init_state(jax.random.PRNGKey(0))
+    rng = np.random.RandomState(0)
+    ids = rng.randint(
+        1, 61, size=(4 * plan.dp * plan.pp, 16)
+    ).astype(np.int32)
+    ids, tg = eng.shard_batch(ids)
+    hlo = eng.train_step.lower(
+        ts, ids, tg, jnp.float32(0.1)
+    ).compile().as_text()
+    records = jaxpr_collective_records(
+        eng.train_step, ts, ids, tg, jnp.float32(0.1)
+    )
+    target = LintTarget(
+        name=combo.name, engine="plan", donate=True,
+        plan_axes=(
+            ("stage", plan.pp), ("data", plan.dp),
+            ("seq", plan.tp_or_sp),
+        ),
+        plan_collective_records=records,
+        n_param_leaves=_n_param_leaves(ts),
+        **_mesh_facts(mesh),
+    )
+    return target, hlo, mesh
+
+
 _BUILDERS: dict = {
     "dp": _build_data_engine,
     "ddp": _build_data_engine,
@@ -1077,6 +1231,7 @@ _BUILDERS: dict = {
     "cm_rs": _build_cm_op,
     "serve": _build_serve,
     "ep": _build_ep,
+    "plan": _build_plan,
 }
 
 
@@ -1173,6 +1328,14 @@ def full_matrix() -> List[Combo]:
     combos.append(Combo("serve", 2, page_size=8,
                         collective_matmul=True, speculative_k=4))
     combos += [Combo("pipeline", 2), Combo("pipeline", 4)]
+    # Composed ParallelPlan lowerings (ISSUE 19): the genuinely
+    # composed 3-axis plan on all 8 devices plus its fsdp-sharded
+    # twin — rules plan-wire-fabric / plan-seq-fabric /
+    # plan-grad-fabric pin each axis's collectives to its contracted
+    # fabric in the composed lowering. (The 4-device pp2xsp2 plan
+    # rides in via pregate_matrix().)
+    combos.append(Combo("plan", 8, plan="pp2xsp2xdp2"))
+    combos.append(Combo("plan", 8, plan="pp2xsp2xfsdp2"))
     combos.append(Combo("tp", 4, collective_matmul=True, bf16=True))
     combos.append(Combo("sp", 4, collective_matmul=True, bf16=True))
     # MoE dispatch (PR 10): the GSPMD 'expert'-axis baseline plus the
@@ -1230,7 +1393,9 @@ def pregate_matrix() -> List[Combo]:
     `decode-quantized-matmul` (or a broken ring with
     `serve-decode-ring`) named, and one speculative paged+ringed serve
     combo so a verify step that falls off the rings fails with
-    `spec-verify-step` named."""
+    `spec-verify-step` named, and one tiny-GPT-sized composed-plan
+    combo (ISSUE 19) so a composed lowering whose collectives leave
+    their contracted fabric fails with a plan-* rule named."""
     return [
         Combo("ddp", 8, grad_reduction="overlapped", model="tinycnn"),
         Combo("fsdp", 8, grad_reduction="overlapped", model="tinycnn"),
@@ -1242,6 +1407,7 @@ def pregate_matrix() -> List[Combo]:
               compute_dtype="int8"),
         Combo("serve", 2, page_size=8, collective_matmul=True,
               speculative_k=2),
+        Combo("plan", 4, plan="pp2xsp2"),
     ]
 
 

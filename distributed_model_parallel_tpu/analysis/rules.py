@@ -163,6 +163,20 @@ class LintTarget:
     quant_dot_count: Optional[int] = None
     head_weight_shape: Optional[Tuple[int, ...]] = None
 
+    # Composed-plan expectations (ISSUE 19, engine == "plan"):
+    # `plan_axes` is the ordered {axis: ways} assignment of the
+    # lowered ParallelPlan's ('stage', 'data', 'seq') mesh;
+    # `plan_collective_records` is the traced-jaxpr record of EVERY
+    # named-axis collective equation in one train step —
+    # ((primitive, axis_names, dtype_token, scope, n_elems), ...) —
+    # trace-level like the other named-axis contracts because
+    # compiled CPU HLO normalizes dtypes and flattens axis names to
+    # replica groups (see lint.jaxpr_collective_records).
+    plan_axes: Tuple[Tuple[str, int], ...] = ()
+    plan_collective_records: Tuple[
+        Tuple[str, Tuple[str, ...], str, str, int], ...
+    ] = ()
+
     # rule_id -> reason; the finding is reported but not counted
     # (module docstring).
     exemptions: Dict[str, str] = dataclasses.field(default_factory=dict)
@@ -1122,6 +1136,133 @@ def _collective_fabric_known(ctx: LintContext) -> List[Finding]:
                 f"{c.name}: {c.kind} membership does not resolve to "
                 "mesh coordinates",
                 c.name,
+            ))
+    return out
+
+
+# The 'seq'-ring scope words a composed plan may carry: ring
+# attention's K/V rotation plus the two collective-matmul rings
+# (`ops/ring_attention.py`, `ops/collective_matmul.py`). Word-matched
+# (\b), same discipline as BF16_RING_EXEMPT_SCOPES.
+PLAN_SEQ_SCOPE_WORDS = ("kv_ring", "ag_matmul", "matmul_rs")
+
+
+@rule(
+    id="plan-wire-fabric", severity="error", source="ISSUE 19",
+    contract=(
+        "A composed plan's pipeline wire rides the stage fabric (the "
+        "plan mesh's DCN contract) and nothing else: every "
+        "`plan_wire`-scoped collective in the traced step is a "
+        "ppermute over exactly ('stage',), and a pp>1 plan must "
+        "trace at least one (the forward hop; its transpose rides "
+        "the same scope)."
+    ),
+    applies=lambda t: t.engine == "plan",
+)
+def _plan_wire_fabric(ctx: LintContext) -> List[Finding]:
+    t = ctx.target
+    axes_of = dict(t.plan_axes)
+    wire = [
+        r for r in t.plan_collective_records
+        if _scope_word("plan_wire", r[3])
+    ]
+    if axes_of.get("stage", 1) > 1 and not wire:
+        return [ctx.finding(
+            "plan-wire-fabric",
+            "no plan_wire-scoped collectives traced on a pp>1 plan — "
+            "the wire pin was not checked",
+        )]
+    out = []
+    for prim, axes, dt, scope, elems in wire:
+        if prim != "ppermute" or tuple(axes) != ("stage",):
+            out.append(ctx.finding(
+                "plan-wire-fabric",
+                f"plan_wire {prim} over {tuple(axes)} ({elems} x "
+                f"{dt}, scope {scope!r}) — the activation wire is a "
+                "ppermute over ('stage',) only",
+            ))
+    return out
+
+
+@rule(
+    id="plan-seq-fabric", severity="error", source="ISSUE 19",
+    contract=(
+        "A composed plan keeps its sequence-axis rings on the ICI "
+        "fabric: every kv_ring / ag_matmul / matmul_rs-scoped "
+        "collective rides exactly ('seq',) — never 'stage' or "
+        "'data' — and an sp>1 ring-attention plan must trace at "
+        "least one kv_ring hop."
+    ),
+    applies=lambda t: t.engine == "plan",
+)
+def _plan_seq_fabric(ctx: LintContext) -> List[Finding]:
+    t = ctx.target
+    axes_of = dict(t.plan_axes)
+    rings = [
+        r for r in t.plan_collective_records
+        if any(_scope_word(w, r[3]) for w in PLAN_SEQ_SCOPE_WORDS)
+    ]
+    if axes_of.get("seq", 1) > 1 and not rings:
+        return [ctx.finding(
+            "plan-seq-fabric",
+            "no seq-ring collectives traced on an sp>1 plan — the "
+            "ring pin was not checked",
+        )]
+    out = []
+    for prim, axes, dt, scope, elems in rings:
+        if tuple(axes) != ("seq",):
+            out.append(ctx.finding(
+                "plan-seq-fabric",
+                f"seq-ring {prim} (scope {scope!r}) over "
+                f"{tuple(axes)} — the rings ride ('seq',) only",
+            ))
+    return out
+
+
+@rule(
+    id="plan-grad-fabric", severity="error", source="ISSUE 19",
+    contract=(
+        "A composed plan reduces gradients as ONE fused psum over "
+        "the full ('stage', 'data', 'seq') tuple under the "
+        "`plan_grad` scope (complementary stage pieces + seq "
+        "partials + data replicas in a single rendezvous — never a "
+        "per-axis cascade), and the FSDP weight materialization — "
+        "when the plan shards — is `plan_fsdp_gather`-scoped "
+        "all-gathers over ('data',) only."
+    ),
+    applies=lambda t: t.engine == "plan",
+)
+def _plan_grad_fabric(ctx: LintContext) -> List[Finding]:
+    t = ctx.target
+    grads = [
+        r for r in t.plan_collective_records
+        if _scope_word("plan_grad", r[3])
+    ]
+    if not grads:
+        return [ctx.finding(
+            "plan-grad-fabric",
+            "no plan_grad-scoped collectives traced — the "
+            "fused-reduction pin was not checked",
+        )]
+    out = []
+    for prim, axes, dt, scope, elems in grads:
+        if prim != "psum" or tuple(sorted(axes)) != (
+            "data", "seq", "stage"
+        ):
+            out.append(ctx.finding(
+                "plan-grad-fabric",
+                f"plan_grad {prim} over {tuple(axes)} — the gradient "
+                "reduction is one fused psum over "
+                "('stage', 'data', 'seq')",
+            ))
+    for prim, axes, dt, scope, elems in t.plan_collective_records:
+        if not _scope_word("plan_fsdp_gather", scope):
+            continue
+        if prim != "all_gather" or tuple(axes) != ("data",):
+            out.append(ctx.finding(
+                "plan-grad-fabric",
+                f"plan_fsdp_gather {prim} over {tuple(axes)} — the "
+                "ZeRO-3 weight gather rides ('data',) only",
             ))
     return out
 

@@ -95,6 +95,13 @@ def build_parser() -> argparse.ArgumentParser:
                              "of the partitioner's monolithic "
                              "all-gather/reduce-scatter (same math; "
                              "transformer-family models)")
+    parser.add_argument("--plan", default=None, metavar="SPEC",
+                        help="degenerate ParallelPlan spec for the "
+                             "image engines (dpN / fsdpN, ISSUE 19): "
+                             "the declarative spelling of --engine "
+                             "ddp/fsdp on an N-way data world; "
+                             "pp/sp/ep tokens are the LM CLI's "
+                             "surface (cli/lm.py --plan)")
     add_grad_reduction_flags(parser)
     add_checkpoint_flags(parser)
     from distributed_model_parallel_tpu.tuning.apply import (
@@ -152,6 +159,30 @@ def main(argv=None) -> dict:
 
         initialize_backend()
         auto_tune_data_parallel(args)
+    _plan = None
+    if args.plan:
+        from distributed_model_parallel_tpu.parallel.plan import (
+            parse_plan,
+        )
+
+        try:
+            _plan = parse_plan(args.plan)
+        except ValueError as e:
+            raise SystemExit(f"--plan: {e}") from e
+        if _plan.pp > 1 or _plan.tp_or_sp > 1 or _plan.ep > 1:
+            raise SystemExit(
+                f"--plan {_plan.spec}: the image engines run the "
+                "data axis only — the plan's pp/sp/ep fields are the "
+                "LM CLI's surface (cli/lm.py --plan)"
+            )
+        want = "fsdp" if _plan.fsdp else "ddp"
+        if args.engine not in ("gspmd", want):
+            raise SystemExit(
+                f"--plan {_plan.spec} spells --engine {want} (plan "
+                f"field {'fsdp' if _plan.fsdp else 'dp'}); it "
+                f"conflicts with --engine {args.engine} — drop one"
+            )
+        args.engine = want
     check_grad_reduction_args(args)
     check_checkpoint_args(args)
     from distributed_model_parallel_tpu.cli.common import (
@@ -226,6 +257,12 @@ def main(argv=None) -> dict:
                 "do nothing — set --model-shards >= 2"
             )
     initialize_backend()
+    if _plan is not None and _plan.num_devices != jax.device_count():
+        raise SystemExit(
+            f"--plan {_plan.spec} factors {_plan.num_devices} "
+            f"device(s); this world has {jax.device_count()} — "
+            "respell the plan's data axis"
+        )
     if args.engine == "tp":
         mesh = make_mesh(MeshSpec(data=-1, model=args.model_shards))
     else:

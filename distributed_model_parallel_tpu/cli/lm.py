@@ -141,6 +141,16 @@ def build_parser() -> argparse.ArgumentParser:
                         "overlaps the partial dot already on hand "
                         "(same math; requires --ffn-dim divisible by "
                         "--seq-shards)")
+    p.add_argument("--plan", default=None, metavar="SPEC|auto",
+                   help="composed ParallelPlan spec (parallel/plan.py, "
+                        "ISSUE 19): one declarative mesh factorization "
+                        "— tokens ppN/spN/dpN/fsdpN joined by 'x', e.g. "
+                        "pp2xsp2xdp2 or fsdp8 — driven through "
+                        "build_plan_engine (degenerate specs route to "
+                        "the single-axis engines). Replaces the "
+                        "per-axis flags (--pipeline-stages, "
+                        "--seq-shards); 'auto' lets --auto-tune pick "
+                        "the spec from the plan family's search space")
     add_grad_reduction_flags(p)
     add_checkpoint_flags(p)
     from distributed_model_parallel_tpu.tuning.apply import (
@@ -183,6 +193,89 @@ def main(argv=None) -> dict:
         )
 
         auto_tune_lm(args)
+    plan = None
+    if args.plan:
+        from distributed_model_parallel_tpu.parallel.plan import (
+            parse_plan,
+        )
+
+        if args.plan == "auto":
+            raise SystemExit(
+                "--plan auto rides the tuner: add --auto-tune search "
+                "(or --auto-tune PLAN.json) to pick the spec from the "
+                "plan family's search space"
+            )
+        try:
+            plan = parse_plan(args.plan)
+        except ValueError as e:
+            raise SystemExit(f"--plan: {e}") from e
+        if args.pipeline_stages > 1 or args.seq_shards > 1:
+            raise SystemExit(
+                f"--plan {plan.spec} IS the mesh factorization; it "
+                "composes with neither --pipeline-stages nor "
+                "--seq-shards (the plan's pp/sp fields replace them) "
+                "— drop the per-axis flags"
+            )
+        if args.pipeline_schedule != "gpipe" or args.virtual_stages != 1:
+            raise SystemExit(
+                f"plan {plan.spec} runs the composed gpipe tick "
+                "program over its pp field; --pipeline-schedule "
+                "1f1b/interleaved and --virtual-stages ride "
+                "--pipeline-stages, not --plan"
+            )
+        if args.microbatches != 1 and plan.pp <= 1:
+            raise SystemExit(
+                f"--microbatches schedules the plan's pipeline axis, "
+                f"but plan {plan.spec} has pp=1 — add a ppN token or "
+                "drop the flag"
+            )
+        if plan.ep > 1:
+            raise SystemExit(
+                f"plan {plan.spec}: the CLI's expert surface is "
+                "--moe-experts/--moe-dispatch (experts ride the data "
+                "fabric); the plan's ep field is the engine/tuner "
+                "surface — drop the ep token"
+            )
+        if args.moe_experts > 0:
+            raise SystemExit(
+                f"--moe-experts trains under the expert-parallel "
+                f"engine, but plan {plan.spec} has ep=1 — pp/sp/fsdp "
+                "x ep plans are not built (ROADMAP item 1); drop "
+                "--plan or --moe-experts"
+            )
+        if args.attention != "ring" and plan.tp_or_sp <= 1:
+            raise SystemExit(
+                f"--attention selects the 'seq'-axis distribution, "
+                f"but plan {plan.spec} has sp=1 (stages attend "
+                "locally, dense causal) — add an spN token or drop "
+                "the flag"
+            )
+        if args.collective_matmul and plan.tp_or_sp <= 1:
+            raise SystemExit(
+                f"--collective-matmul rings over the plan's 'seq' "
+                f"axis, but plan {plan.spec} has sp=1 — add an spN "
+                "token or drop the flag"
+            )
+        if args.dcn_slices != 1:
+            raise SystemExit(
+                f"--dcn-slices factors the data axis for the "
+                "hierarchical reducer; the stage-major plan mesh "
+                f"(plan {plan.spec}) lays its pp field across the "
+                "slice boundary by construction — drop the flag"
+            )
+        if (
+            args.grad_reduction != "monolithic"
+            or args.dcn_compression != "none"
+            or args.bucket_mb is not None
+            or args.overlap_stages is not None
+        ):
+            raise SystemExit(
+                f"plan {plan.spec} reduces gradients with ONE fused "
+                "psum over ('stage','data','seq'); the "
+                "--grad-reduction/--bucket-mb/--overlap-stages/"
+                "--dcn-compression knobs ride the single-axis "
+                "engines — drop the flags or --plan"
+            )
     if args.pipeline_stages > 1 and args.seq_shards > 1:
         raise SystemExit(
             "--pipeline-stages and --seq-shards are mutually exclusive "
@@ -194,7 +287,9 @@ def main(argv=None) -> dict:
             "engine's FFN collectives; it has no effect under "
             "--pipeline-stages (stages compute dense locally)"
         )
-    if args.collective_matmul and args.seq_shards < 2:
+    if args.collective_matmul and args.seq_shards < 2 and plan is None:
+        # Under --plan the sp-field guard above already ruled (a plan
+        # with sp >= 2 carries a real 'seq' ring for the cm chunks).
         raise SystemExit(
             "--collective-matmul rings over the 'seq' axis; a size-1 "
             "ring is a plain dot, so the flag would silently do "
@@ -210,17 +305,22 @@ def main(argv=None) -> dict:
             "and has no effect under --pipeline-stages (stages attend "
             "locally, dense causal); drop the flag"
         )
-    if args.pipeline_stages <= 1 and args.microbatches != 1:
+    if (args.pipeline_stages <= 1 and args.microbatches != 1
+            and plan is None):
+        # A plan with pp > 1 accepts --microbatches (the composed tick
+        # loop's M); the plan block above rules the pp=1 case.
         raise SystemExit(
             "--microbatches is a pipeline-schedule knob; it has no "
             "effect without --pipeline-stages > 1"
         )
-    if args.pipeline_stages <= 1 and args.pipeline_schedule != "gpipe":
+    if (args.pipeline_stages <= 1 and args.pipeline_schedule != "gpipe"
+            and plan is None):
         raise SystemExit(
             "--pipeline-schedule selects the pipeline engine's tick "
             "program; it has no effect without --pipeline-stages > 1"
         )
-    if args.pipeline_stages <= 1 and args.virtual_stages != 1:
+    if (args.pipeline_stages <= 1 and args.virtual_stages != 1
+            and plan is None):
         raise SystemExit(
             "--virtual-stages is an interleaved-pipeline knob; it has "
             "no effect without --pipeline-stages > 1"
@@ -338,7 +438,32 @@ def main(argv=None) -> dict:
             f"chunks exceeds --layers {args.layers}: a chunk needs at "
             f"least one decoder block"
         )
-    if args.pipeline_stages > 1:
+    if plan is not None:
+        # build_plan_engine lays its own stage-major plan mesh; the
+        # divisibility checks mirror check_batch_divisibility for the
+        # composed tick program's shapes.
+        mesh = None
+        n_dev = len(jax.devices())
+        if plan.num_devices > n_dev:
+            raise SystemExit(
+                f"--plan {plan.spec} needs {plan.num_devices} "
+                f"device(s), {n_dev} present"
+            )
+        plan_mb = (
+            args.microbatches if args.microbatches != 1 else plan.pp
+        )
+        if args.batch_size % max(plan.dp * plan_mb, 1):
+            raise SystemExit(
+                f"--batch-size {args.batch_size} must divide into "
+                f"{plan_mb} microbatch(es) x {plan.dp}-way 'data' "
+                f"shards (plan {plan.spec})"
+            )
+        if args.seq_len % plan.tp_or_sp:
+            raise SystemExit(
+                f"--seq-len {args.seq_len} not divisible by plan "
+                f"{plan.spec}'s {plan.tp_or_sp}-way 'seq' axis"
+            )
+    elif args.pipeline_stages > 1:
         mesh = make_mesh(MeshSpec(data=-1, stage=args.pipeline_stages))
         check_batch_divisibility(
             args.batch_size, mesh, microbatches=args.microbatches
@@ -383,7 +508,26 @@ def main(argv=None) -> dict:
         num_experts=args.moe_experts,
         moe_every=args.moe_every,
     )
-    if args.pipeline_stages > 1:
+    if plan is not None:
+        from distributed_model_parallel_tpu.parallel.plan import (
+            build_plan_engine,
+        )
+
+        try:
+            engine = build_plan_engine(
+                cfg, build_optimizer(args), plan,
+                num_microbatches=(
+                    args.microbatches if args.microbatches != 1
+                    else None
+                ),
+                attention=args.attention,
+                collective_matmul=args.collective_matmul,
+                compute_dtype=compute_dtype_from_flag(args.dtype),
+                remat=args.remat,
+            )
+        except (ValueError, NotImplementedError) as e:
+            raise SystemExit(f"--plan {plan.spec}: {e}") from e
+    elif args.pipeline_stages > 1:
         from distributed_model_parallel_tpu.models.gpt import split_stages
         from distributed_model_parallel_tpu.parallel.pipeline import (
             LMPipelineEngine,

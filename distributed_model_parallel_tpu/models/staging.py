@@ -133,6 +133,21 @@ def partition_tree(tree: Any, cuts: Sequence[int]) -> List[dict]:
     return out
 
 
+def stack_block_params(blocks_tree: dict, n_blocks: int) -> Any:
+    """Stack the `{'0'.., str(n_blocks-1)}` per-block param subtrees
+    (identical structure by construction — the uniform-block model
+    families) along a new leading block axis. The composed-plan engine
+    (`parallel/plan.py`) slices this stacked tensor by stage index so
+    every device runs ONE shared block apply over its contiguous slice
+    — the uniform-program counterpart of `partition_tree`'s per-stage
+    cut trees (which allow uneven cuts but produce per-stage
+    structures a single traced program cannot select among)."""
+    return jax.tree_util.tree_map(
+        lambda *xs: jnp.stack(xs),
+        *[blocks_tree[str(j)] for j in range(n_blocks)],
+    )
+
+
 def unpartition_tree(stage_trees: Sequence[dict],
                      cuts: Sequence[int]) -> dict:
     """Inverse of `partition_tree`: reassemble per-stage sequential-keyed

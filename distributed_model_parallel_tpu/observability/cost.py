@@ -239,6 +239,67 @@ def two_level_all_reduce_s(nbytes: float, ici: int, dcn: int,
     return beta + alpha
 
 
+def composed_plan_step_s(pp: int, sp: int, dp: int,
+                         grad_bytes: float, mb: int, seq_len: int,
+                         dim: int, vocab: int, n_layers: int,
+                         ici: int, dcn: int,
+                         fsdp: bool = False,
+                         constants: Optional[Dict[str, float]] = None,
+                         ) -> float:
+    """Asked-bytes step time of one composed `ParallelPlan` training
+    step (ISSUE 19, `parallel/plan.py`), the plan family's closed form.
+    Three collective legs, each pinned to its fabric by the hlolint
+    plan-* rules:
+
+    wire — the gpipe stage handoff (`plan_wire` ppermute): 2*pp-1
+      ticks, each moving one microbatch activation pair
+      mb x (seq_len/sp) x max(dim, vocab) floats to the next stage.
+      Stages are laid across 'dcn' when the fabric is factored
+      (the plan grid admits pp>1 at dcn>1 only when the slice boundary
+      falls between stages), else ICI.
+    seq — ring-attention KV hops over 'seq' (sp-1 ppermutes of the
+      mb x (seq_len/sp) x dim K and V shards per layer) inside every
+      tick's stage slice: ICI always (plan-seq-fabric pins it).
+    grad — ONE fused gradient psum over ('stage','data','seq')
+      (`plan_grad`): multislice XLA decomposes a global all-reduce
+      hierarchically, so at dcn>1 it prices as the two-level form over
+      (group/dcn) x dcn, else a flat ring over the whole group.
+    fsdp adds the per-step param all-gather (`plan_fsdp_gather`) over
+      'data' — DCN-facing only when the data axis is what crosses the
+      slice boundary (pp == 1)."""
+    bw_ici, a_ici, bw_dcn, a_dcn = _resolve_constants(constants)
+    ticks = 2 * pp - 1
+    total = 0.0
+    if pp > 1:
+        wire_bytes = mb * (seq_len // sp) * max(dim, vocab) * 4
+        bw, a = (bw_dcn, a_dcn) if dcn > 1 else (bw_ici, a_ici)
+        total += ticks * (a + wire_bytes / bw)
+    if sp > 1:
+        kv_bytes = 2 * mb * (seq_len // sp) * dim * 4
+        total += (
+            ticks * (n_layers // pp) * (sp - 1)
+            * (a_ici + kv_bytes / bw_ici)
+        )
+    group = pp * sp * dp
+    if group > 1:
+        if dcn > 1:
+            total += two_level_all_reduce_s(
+                grad_bytes, group // dcn, dcn, n_buckets=1,
+                constants=constants,
+            )
+        else:
+            total += ring_all_reduce_s(
+                grad_bytes, group, 1, bw_ici, a_ici
+            )
+    if fsdp and dp > 1:
+        bw, a = (
+            (bw_dcn, a_dcn) if (dcn > 1 and pp == 1)
+            else (bw_ici, a_ici)
+        )
+        total += (dp - 1) * a + (dp - 1) / dp * grad_bytes / bw
+    return total
+
+
 def flat_all_to_all_s(elems: int, itemsize: int, ici: int,
                       dcn: int,
                       constants: Optional[Dict[str, float]] = None,
@@ -745,6 +806,7 @@ __all__ = [
     "WIRE_ITEMSIZE",
     "add_serve_compute",
     "combo_cost",
+    "composed_plan_step_s",
     "serve_combo_compute_s",
     "fabrics_from_constants",
     "flat_all_to_all_s",

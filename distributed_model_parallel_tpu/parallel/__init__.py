@@ -24,3 +24,9 @@ from distributed_model_parallel_tpu.parallel.expert_parallel import (  # noqa: F
 from distributed_model_parallel_tpu.parallel.fsdp import (  # noqa: F401
     FSDPEngine,
 )
+from distributed_model_parallel_tpu.parallel.plan import (  # noqa: F401
+    ComposedPlanEngine,
+    ParallelPlan,
+    build_plan_engine,
+    parse_plan,
+)

@@ -1,0 +1,839 @@
+"""One `ParallelPlan`: composable PP x TP/SP x FSDP-DP x EP over the
+factored mesh (ISSUE 19).
+
+The per-axis engines (`pipeline.py`, `sequence_parallel.py`, `fsdp.py`,
+`expert_parallel.py`) each own a whole mesh; this module composes their
+mechanisms into ONE engine driven by a declarative plan
+
+    ParallelPlan(pp=S_pp, tp_or_sp=S_tp, dp=S_dp, fsdp=..., ep=S_ep)
+
+assigned onto the stage-major ('stage', 'data', 'seq') mesh of
+`runtime.mesh.make_plan_mesh` — the Megatron-LM SC'21 composition
+(Narayanan et al., PAPERS.md): pipeline stages across the slow fabric
+(stage outermost = DCN; their only traffic is one activation ppermute
+per tick), tensor/sequence sharding within a slice ('seq' innermost =
+ICI neighbors for the ring-attention / collective-matmul rings),
+ZeRO-style FSDP data parallelism on the remainder, and the expert axis
+riding the data fabric (DeepSpeed-MoE, Rajbhandari ICML'22).
+
+Why one fully-MANUAL shard_map: on this jax (0.4.37) a partial-auto
+shard_map (manual 'stage', GSPMD inside) dies in XLA SPMD partitioning
+(PartitionId UNIMPLEMENTED / IsManualSubgroup check-fail), so hybrid
+manual-over-auto composition is not a viable substrate. Every axis's
+mechanism therefore composes at the shard_map level, reusing the
+single-axis engines' building blocks verbatim:
+
+  stage — the gpipe fill-drain tick loop of `PipelineEngine`
+          (`pipeline_forward`): M + S - 1 ticks, one packed-activation
+          ppermute per tick (scope `plan_wire`), loss ONLY on the last
+          stage with NO psum before grad (under check_vma=False a
+          differentiated psum mis-scales cotangents; the reversed
+          ppermutes alone carry the true cotangents upstream). The
+          per-tick program is UNIFORM across stages — every device
+          runs stem + (its stage's block slice, a `dynamic_slice` of
+          the STACKED block params scanned with one shared block
+          apply) + head, with `where`-selects on the stage index for
+          the wire/loss — never `lax.switch` over per-stage closures:
+          a 'seq' collective inside a stage-selected branch lowers to
+          ONE collective op spanning all devices while only that
+          stage's devices execute it, which deadlocks the SPMD
+          runtime at the rendezvous.
+  seq   — `CausalLMSequenceParallelEngine`'s per-shard GPT math: the
+          shard-aware position slice, ring attention with causal=True
+          over 'seq', host-side `lm_targets` sharded alongside the ids
+          so every shard scores its own tokens locally, optional
+          `LocalCollectiveMatmul(axis='seq')` FFN rings. This is the
+          plan's `tp_or_sp` leg (Megatron-SP: sequence sharding with
+          TP-style rings within ICI).
+  data  — the SP/DDP gradient discipline: per-device grads are
+          complementary pieces (zero off-stage, partial per seq shard,
+          per-replica sums over 'data'), so ONE fused psum over
+          ('stage', 'data', 'seq') (scope `plan_grad`) divided by the
+          global valid-token count reproduces the dense mean-loss
+          gradient exactly. `fsdp=True` additionally shards parameters
+          and optimizer moments 1/dp at rest (`fsdp.fsdp_specs` over
+          'data'), all-gathers them on entry (scope `fsdp_gather`) and
+          slices each device's own shard after reduction — ZeRO-3 on
+          the plan's data axis.
+  ep    — experts ride the data axes: an `ep > 1` plan routes through
+          `ExpertParallelLMEngine`'s hierarchical dispatch (the EP x DP
+          composition that engine already is). The manual composed
+          engine refuses MoE configs (the per-stage aux-loss channel
+          through the gpipe scalar is future work — see ROADMAP).
+
+Every single-axis engine is the degenerate 1-on-the-other-axes plan:
+`build_plan_engine` routes pp-only plans to `LMPipelineEngine`, sp-only
+plans to `CausalLMSequenceParallelEngine`, ep plans to
+`ExpertParallelLMEngine`, and everything genuinely composed (or
+fsdp-sharded) to `ComposedPlanEngine`. Parity — degenerate == existing
+engine == dense, and composed PP2xSP2xDP2 == dense at rtol 1e-5 — is
+pinned in tests/test_plan.py; the per-axis fabric contract of the
+composed lowering is linted by the `plan-*` rules (`analysis/rules.py`).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import re
+from functools import partial
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from distributed_model_parallel_tpu.models import layers as L
+from distributed_model_parallel_tpu.models.staging import (
+    stack_block_params,
+)
+from distributed_model_parallel_tpu.parallel.data_parallel import (
+    TrainState,
+    _metrics,
+    _place_batch,
+)
+from distributed_model_parallel_tpu.parallel.sequence_parallel import (
+    ATTENTION,
+    _check_seq_len,
+    _seq_matmul_policy,
+)
+from distributed_model_parallel_tpu.runtime.compat import shard_map
+from distributed_model_parallel_tpu.runtime.mesh import make_plan_mesh
+from distributed_model_parallel_tpu.training.metrics import cross_entropy
+
+PLAN_AXES = ("pp", "tp_or_sp", "dp", "ep")
+# Spec-string vocabulary: every alias maps to its ParallelPlan field.
+# "sp" and "tp" both mean the tp_or_sp axis (the within-ICI leg is
+# implemented as Megatron-SP sequence sharding with TP-style rings);
+# "fsdp" means the dp axis with parameter sharding on.
+_TOKEN_FIELD = {
+    "pp": "pp", "sp": "tp_or_sp", "tp": "tp_or_sp",
+    "dp": "dp", "fsdp": "dp", "ep": "ep",
+}
+_TOKEN_RE = re.compile(r"^(pp|sp|tp|dp|fsdp|ep)(\d+)$")
+
+
+@dataclasses.dataclass(frozen=True)
+class ParallelPlan:
+    """Declarative axis assignment: how many ways each parallelism axis
+    runs. `fsdp` shards parameters/moments over the dp axis (ZeRO-3);
+    `tp_or_sp` is the within-slice tensor/sequence leg. The product of
+    all axes is the device count the plan occupies."""
+
+    pp: int = 1
+    tp_or_sp: int = 1
+    dp: int = 1
+    ep: int = 1
+    fsdp: bool = False
+
+    def __post_init__(self):
+        for name in PLAN_AXES:
+            v = getattr(self, name)
+            if not isinstance(v, int) or v < 1:
+                raise ValueError(
+                    f"ParallelPlan.{name} must be an int >= 1, got {v!r}"
+                )
+        if self.fsdp and self.dp < 2:
+            raise ValueError(
+                "ParallelPlan(fsdp=True) shards parameters over the dp "
+                f"axis; dp={self.dp} leaves nothing to shard"
+            )
+
+    @property
+    def num_devices(self) -> int:
+        return self.pp * self.tp_or_sp * self.dp * self.ep
+
+    @property
+    def spec(self) -> str:
+        """Canonical spec string (`parse_plan` round-trips it)."""
+        bits = []
+        if self.pp > 1:
+            bits.append(f"pp{self.pp}")
+        if self.tp_or_sp > 1:
+            bits.append(f"sp{self.tp_or_sp}")
+        if self.dp > 1 or not bits:
+            bits.append(("fsdp" if self.fsdp else "dp") + str(self.dp))
+        if self.ep > 1:
+            bits.append(f"ep{self.ep}")
+        return "x".join(bits)
+
+
+def parse_plan(spec: str) -> ParallelPlan:
+    """`"pp2xsp2xdp2"` -> ParallelPlan(pp=2, tp_or_sp=2, dp=2).
+
+    Tokens are axis-name + ways, joined by 'x': pp / sp (alias tp) /
+    dp / fsdp (dp with parameter sharding) / ep. Each axis may appear
+    once; omitted axes default to 1."""
+    fields: dict = {}
+    fsdp = False
+    for token in str(spec).strip().lower().split("x"):
+        m = _TOKEN_RE.match(token.strip())
+        if not m:
+            raise ValueError(
+                f"bad plan token {token!r} in {spec!r}: expected "
+                "<axis><ways> with axis in pp/sp/tp/dp/fsdp/ep "
+                "(e.g. 'pp2xsp2xdp2', 'fsdp4')"
+            )
+        name, ways = m.group(1), int(m.group(2))
+        field = _TOKEN_FIELD[name]
+        if field in fields:
+            raise ValueError(
+                f"plan {spec!r} names the {field} axis twice"
+            )
+        fields[field] = ways
+        if name == "fsdp":
+            fsdp = True
+    return ParallelPlan(fsdp=fsdp, **fields)
+
+
+def _local_sums(logits, targets):
+    """Per-shard metric SUMS over this shard's tokens (the
+    `CausalLMSequenceParallelEngine.local_sums` contract, one copy for
+    the composed engine)."""
+    b, tl, v = logits.shape
+    flat_logits = logits.reshape(b * tl, v)
+    flat_t = targets.reshape(b * tl)
+    return _metrics(
+        cross_entropy(flat_logits, flat_t), flat_logits, flat_t
+    )
+
+
+# Collective scope words the plan lint rules pin (`analysis/rules.py`):
+# the pipeline wire, the fused gradient reduction, the FSDP weight
+# gather. (The 'seq' rings carry their own op scopes — kv_ring,
+# ag_matmul, matmul_rs.)
+WIRE_SCOPE = "plan_wire"
+GRAD_SCOPE = "plan_grad"
+GATHER_SCOPE = "plan_fsdp_gather"
+
+
+@dataclasses.dataclass
+class ComposedPlanEngine:
+    """GPT LM training under a genuinely composed ParallelPlan: one
+    fully-manual shard_map over the stage-major ('stage', 'data',
+    'seq') plan mesh (module docstring).
+
+    Parameters are identical in structure to `gpt_lm(cfg)` — the
+    CANONICAL (dense) pytree, replicated over 'stage' and 'seq' at
+    rest — so dense checkpoints and every other engine's
+    `to_canonical` form interoperate; with `plan.fsdp` each leaf is
+    additionally sharded 1/dp over 'data' (`fsdp.fsdp_specs`), the
+    optimizer moments follow it, and the sharded-checkpoint manifest
+    records the layout through the same `state_partition_specs` seam
+    as `FSDPEngine` (cross-plan resharding is pinned in
+    tests/test_checkpoint_sharded.py)."""
+
+    cfg: Any  # models.gpt.GPTConfig
+    optimizer: Any  # SGD | AdamW (init/update/state_shardings protocol)
+    mesh: Mesh
+    plan: ParallelPlan = ParallelPlan()
+    # Microbatch count for the gpipe tick loop (None = the stage count,
+    # the minimum that fills the pipeline).
+    num_microbatches: Optional[int] = None
+    attention: str = "ring"
+    donate: bool = True
+    compute_dtype: Any = None
+    remat: bool = False
+    # FFN pair as chunked ppermute rings over 'seq' (default off) — see
+    # SequenceParallelEngine.collective_matmul.
+    collective_matmul: bool = False
+    # FSDP leaves below this many elements stay replicated.
+    min_shard_elems: int = 1024
+
+    def __post_init__(self):
+        from distributed_model_parallel_tpu.models.gpt import (
+            decoder_blocks,
+            gpt_lm,
+            head_apply as lm_head_apply,
+            lm_targets,
+            stem_apply as lm_stem_apply,
+        )
+        from distributed_model_parallel_tpu.ops.attention import (
+            dot_product_attention,
+        )
+
+        mesh = self.mesh
+        plan = self.plan
+        for ax, ways in (
+            ("stage", plan.pp), ("data", plan.dp), ("seq", plan.tp_or_sp)
+        ):
+            if ax not in mesh.axis_names:
+                raise ValueError(
+                    f"composed-plan mesh needs a '{ax}' axis "
+                    f"(make_plan_mesh); got {mesh.axis_names}"
+                )
+            if int(mesh.shape[ax]) != ways:
+                raise ValueError(
+                    f"plan {plan.spec!r} wants {ways}-way '{ax}' but the "
+                    f"mesh carries {int(mesh.shape[ax])}"
+                )
+        if plan.ep > 1:
+            raise NotImplementedError(
+                "ComposedPlanEngine does not run the expert axis; "
+                "ep > 1 plans route through "
+                "parallel/expert_parallel.ExpertParallelLMEngine "
+                "(build_plan_engine does this)"
+            )
+        cfg = self.cfg
+        if getattr(cfg, "num_experts", 0) > 0:
+            # Same objection as the SP engines: the per-stage MoE
+            # aux-loss channel through the gpipe loss scalar is not
+            # built; the MoE text path is ExpertParallelLMEngine.
+            raise NotImplementedError(
+                "GPTConfig.num_experts > 0 is not supported by "
+                "ComposedPlanEngine; train MoE LMs with an ep plan "
+                "(parallel/expert_parallel.ExpertParallelLMEngine)."
+            )
+        if self.attention not in ATTENTION:
+            raise ValueError(
+                f"attention must be one of {sorted(ATTENTION)}, "
+                f"got {self.attention!r}"
+            )
+        S = plan.pp
+        M = self.num_microbatches or S
+        if M < S:
+            raise ValueError(
+                f"num_microbatches={M} cannot fill a {S}-stage "
+                "pipeline (need M >= pp)"
+            )
+        self.num_microbatches = M
+        if cfg.num_layers % S:
+            # The uniform tick program slices a STACKED block-param
+            # tensor by stage index, so every stage must carry the
+            # same number of blocks. Uneven cuts are the single-axis
+            # pipeline's territory.
+            raise ValueError(
+                f"pp={S} must divide cfg.num_layers="
+                f"{cfg.num_layers}: the composed engine runs uniform "
+                "stage slices (uneven cuts -> "
+                "parallel/pipeline.LMPipelineEngine)"
+            )
+        self._lm_targets = partial(
+            lm_targets, pad_token_id=cfg.pad_token_id
+        )
+        sp = plan.tp_or_sp
+        attn_fn = (
+            partial(ATTENTION[self.attention], axis_name="seq",
+                    causal=True)
+            if sp > 1 else partial(dot_product_attention, causal=True)
+        )
+        self._matmul = _seq_matmul_policy(
+            self.collective_matmul and sp > 1, cfg.ffn_dim, sp
+        )
+        mm = self._matmul
+        self._repl = NamedSharding(mesh, P())
+        self._batch = NamedSharding(mesh, P(("data",), ("seq",)))
+        # Dense-parameter twin: init AND the canonical checkpoint form.
+        self._full = gpt_lm(cfg)
+        block_list = decoder_blocks(cfg, attn_fn)
+        if self.remat:
+            block_list = [L.remat(b) for b in block_list]
+        # With num_experts == 0 (enforced above) every decoder block is
+        # the same encoder_layer module — one shared apply over stacked
+        # per-block params is exact.
+        block_apply = block_list[0].apply
+        Lps = cfg.num_layers // S  # blocks per stage (uniform)
+        drop = L.dropout(cfg.dropout_rate)
+        cdt = self.compute_dtype
+        wire_dt = jnp.dtype(cdt) if cdt is not None else jnp.float32
+        V = cfg.vocab_size
+        D = cfg.dim
+        reduce_axes = ("stage", "data", "seq")
+        self._reduce_axes = reduce_axes
+
+        fsdp = plan.fsdp
+        if fsdp:
+            from distributed_model_parallel_tpu.parallel.fsdp import (
+                fsdp_specs,
+            )
+
+            key_aval = jax.ShapeDtypeStruct((2,), jnp.uint32)
+            p_aval, s_aval = jax.eval_shape(self._full.init, key_aval)
+            pspecs = fsdp_specs(
+                p_aval, plan.dp,
+                min_shard_elems=self.min_shard_elems, axes="data",
+            )
+            is_spec = lambda x: isinstance(x, P)  # noqa: E731
+            param_sh = jax.tree_util.tree_map(
+                lambda spec: NamedSharding(mesh, spec), pspecs,
+                is_leaf=is_spec,
+            )
+            self._state_sh = TrainState(
+                param_sh,
+                jax.tree_util.tree_map(lambda _: self._repl, s_aval),
+                self.optimizer.state_shardings(param_sh, self._repl),
+                self._repl,
+            )
+            state_specs = TrainState(
+                pspecs,
+                jax.tree_util.tree_map(lambda _: P(), s_aval),
+                self.optimizer.state_shardings(pspecs, P()),
+                P(),
+            )
+            # The sharded-checkpoint spec seam (FSDPEngine convention).
+            self._state_pspecs = state_specs
+            n_dp = plan.dp
+
+            def _sharded_dim(spec):
+                for d, part in enumerate(spec):
+                    if part is not None:
+                        return d
+                return None
+
+            def gather_params(params):
+                """ZeRO-3 weight materialization on entry: all-gather
+                each 1/dp leaf over 'data' (scope `plan_fsdp_gather`
+                for the plan-grad-fabric lint pin)."""
+
+                def gather(leaf, spec):
+                    d = _sharded_dim(spec)
+                    if d is None:
+                        return leaf
+                    return lax.all_gather(leaf, "data", axis=d,
+                                          tiled=True)
+
+                with jax.named_scope(GATHER_SCOPE):
+                    return jax.tree_util.tree_map(
+                        gather, params, pspecs
+                    )
+
+            def slice_grads(grads):
+                """Each device keeps its own 1/dp of the fully-reduced
+                gradient — local slice, no collective."""
+                idx = lax.axis_index("data")
+
+                def slice_leaf(leaf, spec):
+                    d = _sharded_dim(spec)
+                    if d is None:
+                        return leaf
+                    block = leaf.shape[d] // n_dp
+                    return lax.dynamic_slice_in_dim(
+                        leaf, idx * block, block, axis=d
+                    )
+
+                return jax.tree_util.tree_map(
+                    slice_leaf, grads, pspecs
+                )
+        else:
+            state_specs = P()
+            # The manifest seam still declares the full layout for
+            # replicated plans: every leaf P() (the canonical at-rest
+            # form), so layout-aware tooling reads ONE convention
+            # across plans.
+            key_aval = jax.ShapeDtypeStruct((2,), jnp.uint32)
+            p_aval, s_aval = jax.eval_shape(self._full.init, key_aval)
+            repl_specs = jax.tree_util.tree_map(lambda _: P(), p_aval)
+            self._state_pspecs = TrainState(
+                repl_specs,
+                jax.tree_util.tree_map(lambda _: P(), s_aval),
+                self.optimizer.state_shardings(repl_specs, P()),
+                P(),
+            )
+            gather_params = lambda p: p  # noqa: E731
+            slice_grads = lambda g: g  # noqa: E731
+
+        def run_ticks(params, ids, targets, step, train):
+            """The gpipe fill-drain tick program on ONE device
+            (`pipeline_forward`'s discipline composed with the SP
+            per-shard math), as a UNIFORM per-device program: every
+            tick every device runs stem + its stage's block slice (a
+            `dynamic_slice` of the STACKED block params, scanned with
+            the one shared block apply and the dense Context.child
+            chain — stem -> ctx.child(0), block j ->
+            ctx.child(1).child(j)) + head, with `where`-selects on the
+            stage index picking what reaches the wire and the loss.
+            Stage selection must NOT be `lax.switch` over per-stage
+            closures: a 'seq' ring collective inside a branch lowers
+            to ONE op whose rendezvous spans all devices, but only
+            that stage's devices execute the branch — the rest never
+            arrive, and the runtime deadlocks. M + S - 1 ticks, one
+            `plan_wire` ppermute over 'stage' per tick. Returns the
+            LOCAL metric sums (loss masked to the last stage; no psum
+            — pipeline autodiff discipline)."""
+            bl, tl = ids.shape
+            if bl % M:
+                raise ValueError(
+                    f"local batch {bl} not divisible by "
+                    f"num_microbatches {M}"
+                )
+            mb = bl // M
+            h_elems = mb * tl * D
+            wire_elems = h_elems + mb * tl  # (h, mask) pair
+            buf_size = max(wire_elems, mb * tl * V)
+            s_idx = lax.axis_index("stage")
+            is_first = s_idx == 0
+            is_last = s_idx == S - 1
+            q_idx = lax.axis_index("seq")
+            ids_mbs = ids.reshape(M, mb, tl)
+            tg_mbs = targets.reshape(M, mb, tl)
+            rng_base = jax.random.fold_in(
+                jax.random.fold_in(
+                    jax.random.fold_in(jax.random.PRNGKey(0), step),
+                    lax.axis_index("data"),
+                ),
+                lax.axis_index("seq"),
+            )
+            # This stage's uniform Lps-block slice of the stacked
+            # block params; grads scatter back through the slice to
+            # exactly these blocks (zeros elsewhere), so the fused
+            # stage-psum reassembles the dense gradient.
+            stacked = stack_block_params(
+                params["blocks"], cfg.num_layers
+            )
+            my_blocks = jax.tree_util.tree_map(
+                lambda x: lax.dynamic_slice_in_dim(
+                    x, s_idx * Lps, Lps, axis=0
+                ),
+                stacked,
+            )
+            blk_ids = s_idx * Lps + jnp.arange(Lps)
+
+            def pack(flat):
+                pad = buf_size - flat.shape[0]
+                return jnp.pad(flat, (0, pad)) if pad else flat
+
+            def pack_pair(h, mask):
+                return pack(jnp.concatenate([
+                    h.astype(wire_dt).reshape(-1),
+                    mask.astype(wire_dt).reshape(-1),
+                ]))
+
+            def pack_logits(logits):
+                return pack(logits.astype(wire_dt).reshape(-1))
+
+            def unpack(buf):
+                h = buf[:h_elems].reshape(mb, tl, D)
+                mask = buf[h_elems:wire_elems].reshape(mb, tl) > 0.5
+                return h, mask
+
+            zeros_m = {
+                k: jnp.float32(0.0)
+                for k in ("loss_sum", "correct1", "correct5", "count")
+            }
+
+            def tick(carry, t):
+                buf, m_acc = carry
+                m = t - s_idx
+                valid = (m >= 0) & (m < M)
+                m_safe = jnp.clip(m, 0, M - 1)
+                ids_mb = lax.dynamic_index_in_dim(
+                    ids_mbs, m_safe, keepdims=False
+                )
+                tg_mb = lax.dynamic_index_in_dim(
+                    tg_mbs, m_safe, keepdims=False
+                )
+                # Per-(stage, microbatch) dropout key (the pipeline
+                # engine's convention).
+                rng = jax.random.fold_in(
+                    jax.random.fold_in(rng_base, s_idx), m_safe
+                )
+                ctx = L.Context(
+                    train=train, rng=rng, dtype=cdt, matmul=mm
+                )
+                # Stem on EVERY device (uniform program); only stage
+                # 0 keeps its result. Position slice is seq-shard
+                # aware, like the SP engines.
+                pos = lax.dynamic_slice_in_dim(
+                    params["stem"]["position"], q_idx * tl, tl, axis=0
+                )
+                h0, mask0 = lm_stem_apply(
+                    params["stem"], ids_mb, cfg, drop, ctx.child(0),
+                    positions=pos,
+                )
+                h_in, mask_in = unpack(buf)
+                h = jnp.where(is_first, h0.astype(h_in.dtype), h_in)
+                # Bubble ticks carry an all-False wire mask; fall
+                # back to the (benign) stem mask there so attention
+                # never sees a fully-masked row.
+                mask = jnp.where(is_first | ~valid, mask0, mask_in)
+                block_ctx = ctx.child(1)
+
+                def blk(x, sl):
+                    pb, j = sl
+                    y, _ = block_apply(pb, {}, x, block_ctx.child(j))
+                    return y, None
+
+                (h, mask), _ = lax.scan(
+                    blk, (h, mask), (my_blocks, blk_ids)
+                )
+                # Head on EVERY device; only the last stage's logits
+                # reach the loss/wire.
+                logits = lm_head_apply(params["head"], h)
+                y_pad = jnp.where(
+                    is_last, pack_logits(logits), pack_pair(h, mask)
+                )
+                # Mask bubble ticks so garbage never reaches the wire
+                # or the loss.
+                y_pad = jnp.where(valid, y_pad, jnp.zeros_like(y_pad))
+                # Loss counts only on the last stage's valid ticks;
+                # stays LOCAL (no psum before grad).
+                w = (valid & is_last).astype(jnp.float32)
+                m_tick = _local_sums(
+                    logits.astype(jnp.float32), tg_mb
+                )
+                m_acc = {
+                    k: m_acc[k] + m_tick[k] * w for k in m_acc
+                }
+                if S > 1:
+                    with jax.named_scope(WIRE_SCOPE):
+                        buf = lax.ppermute(
+                            y_pad, "stage",
+                            [(i, i + 1) for i in range(S - 1)],
+                        )
+                return (buf, m_acc), None
+
+            buf0 = jnp.zeros((buf_size,), wire_dt)
+            (_, m_acc), _ = lax.scan(
+                tick, (buf0, zeros_m), jnp.arange(M + S - 1)
+            )
+            return m_acc
+
+        def shard_step(ts: TrainState, ids, targets, lr):
+            full_params = gather_params(ts.params)
+
+            def loss_fn(params):
+                m = run_ticks(params, ids, targets, ts.step, True)
+                # LOCAL token-loss sum (pipeline discipline).
+                return m["loss_sum"], m
+
+            (_, m), grads = jax.value_and_grad(
+                loss_fn, has_aux=True
+            )(full_params)
+            n_global = lax.psum(m["count"], reduce_axes)
+            # Complementary pieces on every axis: zero off-stage,
+            # partial per 'seq' shard, per-replica sums over 'data' —
+            # ONE fused psum, then the dense mean-loss normalization.
+            with jax.named_scope(GRAD_SCOPE):
+                grads = jax.tree_util.tree_map(
+                    lambda g: lax.psum(g, reduce_axes), grads
+                )
+            grads = jax.tree_util.tree_map(
+                lambda g: g / jnp.maximum(n_global, 1.0),
+                slice_grads(grads),
+            )
+            params, opt_state = self.optimizer.update(
+                ts.params, ts.opt_state, grads, lr
+            )
+            new_ts = TrainState(
+                params, ts.model_state, opt_state, ts.step + 1
+            )
+            return new_ts, {
+                k: lax.psum(v, reduce_axes) for k, v in m.items()
+            }
+
+        def shard_eval(ts: TrainState, ids, targets):
+            m = run_ticks(
+                gather_params(ts.params), ids, targets, ts.step, False
+            )
+            return {k: lax.psum(v, reduce_axes) for k, v in m.items()}
+
+        donate = (0,) if self.donate else ()
+        self.train_step = jax.jit(
+            shard_map(
+                shard_step, mesh=mesh,
+                in_specs=(
+                    state_specs, P(("data",), ("seq",)),
+                    P(("data",), ("seq",)), P(),
+                ),
+                out_specs=(state_specs, P()),
+                check_vma=False,
+            ),
+            donate_argnums=donate,
+        )
+        self.eval_step = jax.jit(
+            shard_map(
+                shard_eval, mesh=mesh,
+                in_specs=(
+                    state_specs, P(("data",), ("seq",)),
+                    P(("data",), ("seq",)),
+                ),
+                out_specs=P(),
+                check_vma=False,
+            )
+        )
+
+    def init_state(self, rng: jax.Array) -> TrainState:
+        params, model_state = self._full.init(rng)
+        opt_state = self.optimizer.init(params)
+        ts = TrainState(
+            params, model_state, opt_state, jnp.zeros((), jnp.int32)
+        )
+        sh = self._state_sh if self.plan.fsdp else self._repl
+        return jax.device_put(ts, sh)
+
+    def shard_batch(self, ids, labels=None):
+        """ids (B, T) -> (ids, next-token targets), both sharded over
+        ('data', 'seq') — the SP engine's host-side target convention,
+        replicated over 'stage'. `labels` is ignored (signature-uniform
+        with the other LM engines)."""
+        _check_seq_len(ids, self.cfg.max_position, "GPTConfig")
+        targets = self._lm_targets(ids)
+        ids_arr = _place_batch((ids,), self._batch)[0]
+        targets_arr = _place_batch((targets,), self._batch)[0]
+        return ids_arr, targets_arr
+
+    # ------------------------------------------------ checkpoint seams
+
+    def state_partition_specs(self) -> TrainState:
+        """The PartitionSpec pytree of the runtime TrainState layout —
+        the sharded-checkpoint manifest seam (the FSDPEngine
+        convention): fsdp plans declare their 1/dp 'data' leaves,
+        replicated plans an all-P() tree."""
+        return self._state_pspecs
+
+    def to_canonical(self, ts: TrainState) -> TrainState:
+        """Host-complete (numpy) TrainState for checkpointing. The
+        runtime tree already HAS canonical (dense `gpt_lm`) structure;
+        this only gathers values — one leaf at a time, so the device
+        transient stays a single unsharded leaf (matters for fsdp
+        plans, whose params/moments are 1/dp over 'data')."""
+        from distributed_model_parallel_tpu.training.checkpoint import (
+            tree_to_host,
+        )
+
+        return tree_to_host(ts)
+
+    def from_canonical(self, ts: TrainState) -> TrainState:
+        """Place a canonical (host-complete) TrainState into this
+        plan's runtime layout — the cross-plan RESHARD seam: the
+        canonical form carries no mesh, so a checkpoint saved under a
+        pp2xsp2 plan lands here as full host arrays and this
+        device_put re-slices them for THIS plan's mesh (replicated, or
+        1/dp over 'data' when the plan is fsdp)."""
+        sh = self._state_sh if self.plan.fsdp else self._repl
+        return jax.device_put(ts, sh)
+
+    def to_canonical_sharded(self, ts: TrainState) -> TrainState:
+        """Sharded-checkpoint seam (`checkpointing/save.py`): the
+        runtime TrainState already has canonical TREE structure, so
+        the sharded save path persists the device-sharded leaves
+        directly and each process writes only its addressable chunks
+        (no gather — pinned in tests/test_checkpoint_sharded.py)."""
+        return ts
+
+
+def build_plan_engine(
+    cfg: Any,
+    optimizer: Any,
+    plan: ParallelPlan | str,
+    *,
+    devices=None,
+    num_microbatches: Optional[int] = None,
+    attention: str = "ring",
+    collective_matmul: bool = False,
+    compute_dtype: Any = None,
+    remat: bool = False,
+    donate: bool = True,
+    force_composed: bool = False,
+):
+    """The one engine entry point: a GPT(-MoE) config plus a
+    ParallelPlan (or its spec string) returns the engine that runs it —
+    the composed manual engine for genuinely multi-axis plans, the
+    existing single-axis engine when the plan is its degenerate
+    1-on-the-other-axes form (the degenerate-plan map, INTERNALS §19):
+
+        pp-only           -> LMPipelineEngine     (gpipe, 'stage')
+        sp-only (x dp)    -> CausalLMSequenceParallelEngine
+        ep (x dp)         -> ExpertParallelLMEngine (hierarchical,
+                             experts riding the data axes)
+        dp-only / fsdp /
+        multi-axis        -> ComposedPlanEngine on make_plan_mesh
+
+    `force_composed=True` skips the degenerate routing (the parity
+    tests drive both sides of the map through one call site)."""
+    if isinstance(plan, str):
+        plan = parse_plan(plan)
+    devices = list(devices if devices is not None else jax.devices())
+    if plan.num_devices > len(devices):
+        raise ValueError(
+            f"plan {plan.spec!r} needs {plan.num_devices} devices, "
+            f"{len(devices)} present"
+        )
+    moe = getattr(cfg, "num_experts", 0) > 0
+    if plan.ep > 1 or (moe and not force_composed):
+        if plan.pp > 1 or plan.tp_or_sp > 1 or plan.fsdp:
+            raise NotImplementedError(
+                f"plan {plan.spec!r}: the expert axis composes with dp "
+                "only (experts ride the data fabric through "
+                "ExpertParallelLMEngine); pp/sp/fsdp x ep plans are "
+                "not built — see ROADMAP item 1"
+            )
+        if not moe:
+            raise ValueError(
+                f"plan {plan.spec!r} has ep={plan.ep} but the config "
+                "has no experts (GPTConfig.num_experts == 0)"
+            )
+        from distributed_model_parallel_tpu.parallel.expert_parallel import (
+            ExpertParallelLMEngine,
+        )
+        from distributed_model_parallel_tpu.runtime.mesh import (
+            MeshSpec, make_mesh,
+        )
+
+        n = plan.ep * plan.dp
+        mesh = make_mesh(MeshSpec(data=n), devices=devices[:n])
+        return ExpertParallelLMEngine(
+            cfg, optimizer, mesh, dispatch="hierarchical",
+            donate=donate, compute_dtype=compute_dtype,
+        )
+    axes_used = sum(
+        1 for w in (plan.pp, plan.tp_or_sp, plan.dp) if w > 1
+    )
+    composed = force_composed or plan.fsdp or axes_used > 1
+    if not composed and plan.pp > 1:
+        from distributed_model_parallel_tpu.models.gpt import (
+            split_stages,
+        )
+        from distributed_model_parallel_tpu.parallel.pipeline import (
+            LMPipelineEngine,
+        )
+        from distributed_model_parallel_tpu.runtime.mesh import (
+            MeshSpec, make_mesh,
+        )
+
+        n = plan.pp * plan.dp
+        mesh = make_mesh(
+            MeshSpec(data=plan.dp, stage=plan.pp), devices=devices[:n]
+        )
+        return LMPipelineEngine(
+            split_stages(plan.pp, cfg), optimizer, mesh,
+            num_microbatches=num_microbatches or plan.pp,
+            donate=donate, compute_dtype=compute_dtype, remat=remat,
+            pad_token_id=cfg.pad_token_id,
+        )
+    if not composed and plan.tp_or_sp > 1:
+        from distributed_model_parallel_tpu.parallel.sequence_parallel import (
+            CausalLMSequenceParallelEngine,
+        )
+        from distributed_model_parallel_tpu.runtime.mesh import (
+            MeshSpec, make_mesh,
+        )
+
+        n = plan.tp_or_sp * plan.dp
+        mesh = make_mesh(
+            MeshSpec(data=plan.dp, seq=plan.tp_or_sp),
+            devices=devices[:n],
+        )
+        return CausalLMSequenceParallelEngine(
+            cfg, optimizer, mesh, attention=attention, donate=donate,
+            compute_dtype=compute_dtype, remat=remat,
+            collective_matmul=collective_matmul,
+        )
+    mesh = make_plan_mesh(
+        plan.pp, plan.dp, plan.tp_or_sp,
+        devices=devices[: plan.num_devices],
+    )
+    return ComposedPlanEngine(
+        cfg, optimizer, mesh, plan=plan,
+        num_microbatches=num_microbatches, attention=attention,
+        donate=donate, compute_dtype=compute_dtype, remat=remat,
+        collective_matmul=collective_matmul,
+    )
+
+
+__all__ = [
+    "ComposedPlanEngine",
+    "ParallelPlan",
+    "build_plan_engine",
+    "parse_plan",
+]

@@ -205,6 +205,41 @@ def spec_from_axes(axes: dict) -> MeshSpec:
     )
 
 
+def make_plan_mesh(
+    pp: int, dp: int, sp: int,
+    *,
+    devices: Optional[Sequence[jax.Device]] = None,
+) -> Mesh:
+    """The composed-`ParallelPlan` mesh (`parallel/plan.py`, ISSUE 19):
+    axes ('stage', 'data', 'seq') with the STAGE axis outermost.
+
+    Ordering is the axis->fabric contract: the slowest-varying axis maps
+    to the slowest fabric, so pipeline stages land across slices (DCN —
+    their only traffic is one activation ppermute per tick), the 'seq'
+    axis is innermost (its ring-attention / collective-matmul rings and
+    grad psums need ICI neighbors), and FSDP-DP rides the middle. This
+    is the opposite ordering from `make_mesh` (data-major), which is why
+    the composed engine does not reuse it; the axis NAMES are the
+    existing vocabulary, so `mesh_axes`/`spec_from_axes` and the sharded
+    checkpoint topology records keep working unchanged.
+
+    On a multi-process runtime the stage-major reshape composes with
+    `create_hybrid_device_mesh` the same way `make_mesh` does; single
+    process it is the virtual two-fabric program structure."""
+    for name, v in (("pp", pp), ("dp", dp), ("sp", sp)):
+        if v < 1:
+            raise ValueError(f"plan mesh axis {name} must be >= 1, got {v}")
+    devices = list(devices if devices is not None else jax.devices())
+    need = pp * dp * sp
+    if len(devices) < need:
+        raise ValueError(
+            f"plan mesh pp={pp} x dp={dp} x sp={sp} needs {need} "
+            f"devices, {len(devices)} present"
+        )
+    dev_array = np.asarray(devices[:need]).reshape(pp, dp, sp)
+    return Mesh(dev_array, axis_names=("stage", "data", "seq"))
+
+
 def local_mesh(**axes: int) -> Mesh:
     """Convenience: `local_mesh(stage=4)` on 8 devices → (2, 4, 1, 1) mesh
     (unspecified `data` absorbs the remaining devices)."""

@@ -180,6 +180,7 @@ def auto_tune_data_parallel(args) -> dict:
         ("--overlap-stages", args.overlap_stages is not None),
         ("--dcn-compression", args.dcn_compression != "none"),
         ("--collective-matmul", args.collective_matmul),
+        ("--plan", getattr(args, "plan", None) is not None),
     ))
     if args.engine == "tp":
         if args.model_shards < 2:
@@ -233,9 +234,10 @@ def auto_tune_lm(args) -> dict:
     knobs + collective_matmul when a 'seq' ring axis exists)."""
     if args.pipeline_stages > 1:
         raise SystemExit(
-            "--auto-tune searches the reducer/ring/MoE-dispatch knob "
-            "space; pipeline schedules are not in it — drop "
-            "--pipeline-stages or --auto-tune"
+            "--auto-tune searches the reducer/ring/MoE-dispatch/plan "
+            "knob spaces; hand-set pipeline schedules are not in "
+            "them — drop --pipeline-stages or --auto-tune (pipeline "
+            "factorizations ARE searched via --plan auto)"
         )
     _reject_explicit((
         ("--grad-reduction", args.grad_reduction != "monolithic"),
@@ -245,8 +247,34 @@ def auto_tune_lm(args) -> dict:
         ("--collective-matmul", args.collective_matmul),
         ("--moe-dispatch", args.moe_dispatch != "gspmd"),
         ("--moe-overlap", args.moe_overlap),
+        ("--plan", args.plan not in (None, "auto")),
     ))
     device_count = jax.device_count()
+    if args.plan == "auto":
+        # The plan family (ISSUE 19): the searched knob is the WHOLE
+        # mesh factorization — the argmin spec lands on args.plan and
+        # the CLI's plan path (build_plan_engine) runs it.
+        if args.dcn_slices != 1:
+            raise SystemExit(
+                "--plan auto searches single-slice factorizations "
+                "(the stage-major plan mesh lays pp across the slice "
+                "boundary by construction) — drop --dcn-slices"
+            )
+        if args.moe_experts > 0:
+            raise SystemExit(
+                "--plan auto searches pp/sp/dp/fsdp factorizations; "
+                "MoE LMs tune the ep family (drop --plan auto and "
+                "keep --moe-experts with --auto-tune)"
+            )
+        if device_count < 2:
+            raise SystemExit(
+                "--plan auto needs a >= 2-way device world (one "
+                "device has nothing to factor)"
+            )
+        cell = Cell("plan", device_count)
+        plan = _resolve_plan(args, cell, allow_cm=True)
+        args.plan = plan["knobs"]["plan"]
+        return plan
     if args.moe_experts > 0:
         if args.expert_shards != 1:
             raise SystemExit(

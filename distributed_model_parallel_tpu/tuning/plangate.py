@@ -56,8 +56,10 @@ def grid() -> List[Cell]:
     """The pinned mesh x model grid (acceptance: >= 8 cells): both
     image reducer families on hybrid fabrics at two scales and both
     proxy models, the CausalLM-SP reducer plain and hybrid, the
-    hierarchical-MoE fabric at two scales, the tp ring cell, and the
-    paged-serving cell (page_size x prefill_chunk, ISSUE 15)."""
+    hierarchical-MoE fabric at two scales, the tp ring cell, the
+    paged-serving cell (page_size x prefill_chunk, ISSUE 15), and the
+    composed-plan factorization cell over the full 8-device CI mesh
+    (ISSUE 19: the argmin is a whole ParallelPlan spec)."""
     return [
         Cell("ddp", 4, 2, "mlp"),
         Cell("ddp", 8, 2, "tinycnn"),
@@ -69,6 +71,7 @@ def grid() -> List[Cell]:
         Cell("ep", 8, 2),
         Cell("tp", 4),
         Cell("serve", 2),
+        Cell("plan", 8),
     ]
 
 

@@ -88,6 +88,31 @@ def cell_payload(cell: Cell) -> dict:
 
     from distributed_model_parallel_tpu.analysis import lint as L
 
+    if cell.family == "plan":
+        # The lint plan proxy (`analysis/lint._build_plan`): the same
+        # tiny GPT as sp_lm, plus the STATIC shape facts the composed
+        # closed form (`cost.composed_plan_step_s`) prices the wire and
+        # KV-ring legs from. mb is the per-microbatch row count —
+        # `_build_plan` feeds ids of shape (4 * dp * pp, 16), so every
+        # plan's microbatch carries 4 rows.
+        from distributed_model_parallel_tpu.models.gpt import gpt_lm
+
+        cfg = L._gpt_cfg()
+        key_aval = jax.ShapeDtypeStruct((2,), jnp.uint32)
+        p_aval, _ = jax.eval_shape(gpt_lm(cfg).init, key_aval)
+        grad_bytes = sum(
+            int(math.prod(leaf.shape) or 1)
+            * jnp.dtype(leaf.dtype).itemsize
+            for leaf in jax.tree_util.tree_leaves(p_aval)
+        )
+        return {
+            "grad_bytes": grad_bytes,
+            "mb": 4,
+            "seq_len": cfg.max_position,
+            "dim": cfg.dim,
+            "vocab": cfg.vocab_size,
+            "n_layers": cfg.num_layers,
+        }
     if cell.family in ("ddp", "fsdp"):
         if cell.model == "tinycnn":
             from distributed_model_parallel_tpu.models.tinycnn import (
@@ -239,6 +264,25 @@ def serve_closed_form_s(knobs: dict, payload: dict,
     return comm + payload["new_tokens"] * decode_c
 
 
+def plan_closed_form_s(knobs: dict, payload: dict, ici: int, dcn: int,
+                       constants: Optional[Dict[str, float]] = None,
+                       ) -> float:
+    """Predicted step time for one composed-plan candidate (ISSUE 19):
+    `cost.composed_plan_step_s` over the spec's axis factorization —
+    the gpipe wire leg on its fabric, the ring-attention KV hops on
+    ICI, the ONE fused gradient psum as the hierarchical two-level
+    form at dcn > 1."""
+    from distributed_model_parallel_tpu.observability import cost
+
+    ax = tspace.plan_spec_axes(knobs["plan"])
+    return cost.composed_plan_step_s(
+        ax["pp"], ax["sp"], ax["dp"],
+        payload["grad_bytes"], payload["mb"], payload["seq_len"],
+        payload["dim"], payload["vocab"], payload["n_layers"],
+        ici, dcn, fsdp=ax["fsdp"], constants=constants,
+    )
+
+
 def closed_form_step_s(family: str, knobs: dict, payload: dict,
                        ici: int, dcn: int,
                        constants: Optional[Dict[str, float]] = None,
@@ -255,6 +299,8 @@ def closed_form_step_s(family: str, knobs: dict, payload: dict,
         )
     if family == "serve":
         return serve_closed_form_s(knobs, payload, constants)
+    if family == "plan":
+        return plan_closed_form_s(knobs, payload, ici, dcn, constants)
     return 0.0  # tp: both candidates are finalists; lowering decides
 
 
@@ -280,7 +326,9 @@ def closed_form_argmin(family: str, payload: dict, ici: int, dcn: int,
     the jax-free entry `experiments/scaling64.py` uses to put the
     tuner's @64 answer next to its hand-derived rows."""
     ranked = rank_candidates(
-        family, tspace.candidates(family, dcn, allow_cm=allow_cm),
+        family,
+        tspace.candidates(family, dcn, allow_cm=allow_cm,
+                          size=ici * dcn),
         payload, ici, dcn, constants,
     )
     score, knobs = ranked[0]
@@ -318,6 +366,8 @@ def candidate_combo(cell: Cell, knobs: dict):
             "tp", cell.size,
             collective_matmul=knobs["collective_matmul"],
         )
+    if cell.family == "plan":
+        return Combo("plan", cell.size, plan=knobs["plan"])
     if cell.family == "serve":
         # The paged decode step lowers per page_size; prefill_chunk
         # shapes the HOST loop only (no compiled-step difference), so
@@ -393,7 +443,7 @@ def search_cell(cell: Cell,
     cands = list(
         space_knobs if space_knobs is not None
         else tspace.candidates(cell.family, cell.dcn,
-                               allow_cm=allow_cm)
+                               allow_cm=allow_cm, size=cell.size)
     )
     if not cands:
         raise ValueError(f"{cell.name}: empty candidate space")
@@ -476,6 +526,7 @@ __all__ = [
     "closed_form_argmin",
     "closed_form_step_s",
     "moe_closed_form_s",
+    "plan_closed_form_s",
     "rank_candidates",
     "reducer_closed_form_s",
     "search_cell",

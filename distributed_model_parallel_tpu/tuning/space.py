@@ -31,7 +31,7 @@ from typing import Dict, List, Optional, Tuple
 # matrix's combo vocabulary (`analysis/lint.py` builders), which is
 # what makes "price a candidate" a one-liner: every candidate maps to
 # a Combo the shared lowering path already understands.
-FAMILIES = ("ddp", "fsdp", "sp_lm", "ep", "tp", "serve")
+FAMILIES = ("ddp", "fsdp", "sp_lm", "ep", "tp", "serve", "plan")
 
 
 @dataclasses.dataclass(frozen=True)
@@ -63,6 +63,92 @@ _REDUCER_KNOBS = (
 
 _CM_KNOB = Knob("collective_matmul", (False, True),
                 "--collective-matmul", "collective_matmul")
+
+
+# --------------------------------------------- the composed-plan family
+#
+# The "plan" family searches WHOLE mesh factorizations, not per-engine
+# schedule knobs: each grid value is a ParallelPlan spec string
+# ("pp2xsp2xdp2", "fsdp8", ...) that `build_plan_engine` runs directly
+# (ISSUE 19). This module stays jax-free, so it carries its own mirror
+# of `parallel/plan.py`'s token grammar; the drift pin lives in
+# tests/test_tuning.py (`plan_spec_axes` vs `parse_plan` on the whole
+# grid).
+
+_PLAN_TOKEN_RE = re.compile(r"^(pp|sp|tp|dp|fsdp|ep)(\d+)$")
+
+_PLAN_TOKEN_AXIS = {
+    "pp": "pp", "sp": "sp", "tp": "sp", "dp": "dp", "fsdp": "dp",
+    "ep": "ep",
+}
+
+
+def plan_spec_axes(spec: str) -> dict:
+    """jax-free parse of a plan spec string into
+    {"pp", "sp", "dp", "ep", "fsdp"} — the same grammar as
+    `parallel.plan.parse_plan` (tokens `(pp|sp|tp|dp|fsdp|ep)<n>`
+    joined by 'x', duplicate axes rejected)."""
+    axes = {"pp": 1, "sp": 1, "dp": 1, "ep": 1, "fsdp": False}
+    seen = set()
+    for tok in spec.split("x"):
+        m = _PLAN_TOKEN_RE.match(tok)
+        if not m:
+            raise ValueError(
+                f"bad plan token {tok!r} in {spec!r} (want "
+                "(pp|sp|tp|dp|fsdp|ep)<n> joined by 'x')"
+            )
+        field = _PLAN_TOKEN_AXIS[m.group(1)]
+        if field in seen:
+            raise ValueError(f"duplicate axis {field!r} in {spec!r}")
+        seen.add(field)
+        axes[field] = int(m.group(2))
+        if m.group(1) == "fsdp":
+            axes["fsdp"] = True
+    return axes
+
+
+def _plan_spec(pp: int, sp: int, dp: int, fsdp: bool) -> str:
+    """Spec-string builder matching `ParallelPlan.spec` byte-for-byte:
+    only non-1 axes are emitted, in order pp, sp, dp-or-fsdp (the dp
+    bit also appears when it is the ONLY axis)."""
+    bits = []
+    if pp > 1:
+        bits.append(f"pp{pp}")
+    if sp > 1:
+        bits.append(f"sp{sp}")
+    if dp > 1 or not bits:
+        bits.append(("fsdp" if fsdp else "dp") + str(dp))
+    return "x".join(bits)
+
+
+def plan_specs(total: int) -> tuple:
+    """All power-of-2 (pp, sp, dp) factorizations of `total` devices,
+    each dp>1 point twinned with its fsdp variant. Deterministic order
+    (pp outer, sp inner, dense before fsdp) — the enumeration order is
+    part of the byte-stability contract."""
+    sizes = []
+    w = 1
+    while w <= total:
+        sizes.append(w)
+        w *= 2
+    if sizes[-1] != total:
+        raise ValueError(f"plan grid wants a power-of-2 size, got {total}")
+    out = []
+    for pp in sizes:
+        for sp in sizes:
+            if total % (pp * sp):
+                continue
+            dp = total // (pp * sp)
+            out.append(_plan_spec(pp, sp, dp, False))
+            if dp > 1:
+                out.append(_plan_spec(pp, sp, dp, True))
+    return tuple(out)
+
+
+# The searched grid covers the two mesh sizes the gates pin: the
+# 8-device CI mesh (plangate's plan/S8 cell) and the 64-way scaling
+# study (experiments/scaling64.py §3f).
+_PLAN_GRID = plan_specs(8) + plan_specs(64)
 
 SPACES: Dict[str, Tuple[Knob, ...]] = {
     "ddp": _REDUCER_KNOBS,
@@ -105,6 +191,12 @@ SPACES: Dict[str, Tuple[Knob, ...]] = {
         Knob("speculative_k", (0, 2, 4), "--speculative-k",
              "speculative_k"),
     ),
+    # Composed mesh-axis plans (ISSUE 19): one spec-string knob whose
+    # grid IS the factorization space. The engine field is
+    # `ComposedPlanEngine.plan`; the CLI flag is the training CLIs'
+    # `--plan`. Candidate filtering (device count, DCN slice
+    # boundaries) happens in `_canonicalize` against the cell's mesh.
+    "plan": (Knob("plan", _PLAN_GRID, "--plan", "plan"),),
 }
 
 
@@ -114,11 +206,29 @@ def canonical_key(knobs: dict) -> str:
     return json.dumps(knobs, sort_keys=True)
 
 
-def _canonicalize(family: str, knobs: dict, dcn: int) -> Optional[dict]:
+def _canonicalize(family: str, knobs: dict, dcn: int,
+                  size: Optional[int] = None) -> Optional[dict]:
     """Normalize one raw cross-product point: inapplicable knobs go to
     None so equivalent configurations collapse; invalid combinations
-    (the ones the engines refuse at construction) return None."""
+    (the ones the engines refuse at construction) return None. `size`
+    (total device count) gates the plan family's grid to the cell's
+    mesh."""
     k = dict(knobs)
+    if family == "plan":
+        ax = plan_spec_axes(k["plan"])
+        ndev = ax["pp"] * ax["sp"] * ax["dp"]
+        if size is not None and ndev != size:
+            return None  # grid point for a different mesh size
+        if dcn > 1:
+            # On a factored ('dcn','ici') fabric the slice boundary
+            # must fall BETWEEN pipeline stages (stage wire is the only
+            # collective the plan sends across DCN; pp=1 plans keep the
+            # data axis across slices — the DDP case).
+            if ax["pp"] > 1 and ax["pp"] % dcn:
+                return None
+            if ax["sp"] > ndev // dcn:
+                return None  # a ring-attention hop would cross DCN
+        return k
     if family in ("ddp", "fsdp", "sp_lm"):
         if k["dcn_compression"] != "none" and dcn < 2:
             return None  # no 'dcn' hop to compress (engine guard)
@@ -181,16 +291,24 @@ def preference(family: str, knobs: dict) -> tuple:
             ),
             knobs.get("speculative_k") or 0,
         )
+    if family == "plan":
+        # Equal-cost ties break toward the LEAST-restructured plan:
+        # fewer pipeline stages, then fewer sequence shards, then dense
+        # dp over fsdp (resharding machinery the cost model doesn't pay
+        # for is free complexity).
+        ax = plan_spec_axes(knobs["plan"])
+        return (ax["pp"], ax["sp"], int(ax["fsdp"]))
     # tp: prefer the ring decomposition on a tie (latency hiding).
     return (0 if knobs["collective_matmul"] else 1,)
 
 
-def candidates(family: str, dcn: int = 1,
-               allow_cm: bool = True) -> List[dict]:
+def candidates(family: str, dcn: int = 1, allow_cm: bool = True,
+               size: Optional[int] = None) -> List[dict]:
     """The deduped, deterministically ordered candidate list for one
     engine family on a mesh with `dcn` cross-slice factor. `allow_cm`
     drops the collective_matmul=True half when the run has no ring axis
-    (lm CLI with --seq-shards 1)."""
+    (lm CLI with --seq-shards 1). `size` (total devices) restricts the
+    plan family's spec grid to factorizations of the cell's mesh."""
     if family not in SPACES:
         raise ValueError(
             f"no search space for engine family {family!r} "
@@ -202,7 +320,7 @@ def candidates(family: str, dcn: int = 1,
         raw = {k.name: v for k, v in zip(knob_list, values)}
         if not allow_cm and raw.get("collective_matmul"):
             continue
-        k = _canonicalize(family, raw, dcn)
+        k = _canonicalize(family, raw, dcn, size)
         if k is not None:
             out.setdefault(canonical_key(k), k)
     return [out[key] for key in sorted(out)]
@@ -270,6 +388,8 @@ __all__ = [
     "SPACES",
     "candidates",
     "canonical_key",
+    "plan_spec_axes",
+    "plan_specs",
     "preference",
     "scan_knob_surface",
 ]

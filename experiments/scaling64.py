@@ -489,6 +489,92 @@ def main():
         },
     }
 
+    # ---- 3f. composed-plan rows (ISSUE 19, parallel/plan.py) ---------
+    # The plan family searches WHOLE mesh factorizations of the same
+    # 2x32 fabric: a GPT-XL-ish training step (dim 1024, 16 layers,
+    # vocab 32k, seq 2048, 8-row microbatches) under
+    # `cost.composed_plan_step_s` — gpipe wire ticks across 'dcn',
+    # ring-attention KV hops on 'ici', ONE fused gradient psum priced
+    # as §3b's two-level form. The single-axis degenerate specs (dp64,
+    # fsdp64, pp64) are points IN the plan space, so the tuner's
+    # argmin can never predict worse than the best of them — asserted
+    # like the §3e rows. NOTE the closed forms price what the program
+    # ASKS THE NETWORK for (no compute/memory term), so pure-dp
+    # factorizations — whose only collective is the fused psum —
+    # structurally dominate at this payload; the anatomy rows record
+    # what each added axis COSTS in asked bytes, which is the real
+    # content of the comparison (pp/sp buy memory headroom the model
+    # doesn't price).
+    from distributed_model_parallel_tpu.tuning.search import (
+        closed_form_step_s,
+    )
+
+    PLAN_DIM = 1024
+    PLAN_VOCAB = 32768
+    PLAN_LAYERS = 16
+    PLAN_SEQ = 2048
+    PLAN_MB = 8
+    # ~12 D^2 per decoder block (QKV+proj 4D^2, FFN pair 8D^2) plus
+    # the tied embedding/head table.
+    plan_grad_bytes = (
+        PLAN_LAYERS * 12 * PLAN_DIM * PLAN_DIM
+        + PLAN_VOCAB * PLAN_DIM
+    ) * 4
+    plan_payload = {
+        "grad_bytes": plan_grad_bytes, "mb": PLAN_MB,
+        "seq_len": PLAN_SEQ, "dim": PLAN_DIM, "vocab": PLAN_VOCAB,
+        "n_layers": PLAN_LAYERS,
+    }
+    plan_knobs, plan_argmin_s = closed_form_argmin(
+        "plan", plan_payload, ici, DCN_SLICES,
+    )
+    # Hand dp64 row: the dp-only composed plan's one collective is the
+    # fused psum over all 64 devices — at 2 slices the hierarchical
+    # decomposition IS §3b's two-level form at one bucket.
+    hand_dp64_s = cost.two_level_all_reduce_s(
+        plan_grad_bytes, ici, DCN_SLICES, n_buckets=1
+    )
+    _assert_cost_engine_agrees(
+        "composed-plan dp64 fused psum", hand_dp64_s,
+        closed_form_step_s(
+            "plan", {"plan": "dp64"}, plan_payload, ici, DCN_SLICES
+        ),
+    )
+    plan_single_axis = {}
+    for spec in ("dp64", "fsdp64", "pp64"):
+        s = closed_form_step_s(
+            "plan", {"plan": spec}, plan_payload, ici, DCN_SLICES
+        )
+        plan_single_axis[spec] = round(s, 6)
+        assert plan_argmin_s <= s * (1 + 1e-9), (
+            f"plan-family argmin {plan_argmin_s:.6e}s predicts WORSE "
+            f"than the single-axis plan {spec} at {s:.6e}s — "
+            "single-axis specs are in the plan space, so the search "
+            "is broken"
+        )
+    # Anatomy: what each composed axis ADDS on top of the fused psum.
+    plan_anatomy = {
+        spec: round(closed_form_step_s(
+            "plan", {"plan": spec}, plan_payload, ici, DCN_SLICES
+        ), 6)
+        for spec in ("pp2xdp32", "sp2xdp32", "pp2xsp2xdp16",
+                     "pp2xsp2xfsdp16")
+    }
+    print(f"tuner argmin (composed plan @{DCN_SLICES}x{ici}): "
+          f"{json.dumps(plan_knobs, sort_keys=True)} -> "
+          f"{plan_argmin_s*1e3:.2f} ms (best single-axis: "
+          f"{min(plan_single_axis.values())*1e3:.2f} ms; composed "
+          f"pp2xsp2xdp16: {plan_anatomy['pp2xsp2xdp16']*1e3:.2f} ms)")
+    plan_rows = {
+        "payload": plan_payload,
+        "argmin": {
+            "knobs": plan_knobs,
+            "predicted_s": round(plan_argmin_s, 6),
+        },
+        "single_axis_s": plan_single_axis,
+        "composed_anatomy_s": plan_anatomy,
+    }
+
     out = {
         "n_devices": N,
         "per_chip_batch": PER_CHIP_BATCH,
@@ -546,6 +632,9 @@ def main():
         # tuner argmin rows (tuning/search.py closed forms) — asserted
         # never worse than the hand §3b/§3c configurations above
         "tuned_rows": tuned_rows,
+        # composed-plan factorization rows (ISSUE 19) — argmin
+        # asserted never worse than every single-axis degenerate spec
+        "plan_rows": plan_rows,
     }
     path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                         "scaling64.json")

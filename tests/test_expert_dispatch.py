@@ -344,13 +344,17 @@ def _staged_moe_model(n_blocks=2):
     )
 
 
+@pytest.mark.slow
 def test_ddp_overlapped_composes_with_hierarchical_dispatch(devices):
     """The PR-5 hook: `grad_reduction="overlapped"` (stagewise VJP with
     eager bucket firing + the per-stage moe_aux cotangent channel) +
     `expert_dispatch="hierarchical"` in ONE step == plain DDP on the
     same model, flat AND hybrid fabric, at rtol 1e-5 — the exchanged
     expert-block gradients reassemble through the bucket rings exactly
-    like the replicated dense grads."""
+    like the replicated dense grads. `slow` (tier-1 budget); tier-1
+    twins: test_hierarchical_matches_gspmd_and_dense (the dispatch
+    side) + test_grad_reduction's overlapped-vs-monolithic pins (the
+    reducer side of the same composition)."""
     model = _staged_moe_model()
     _, plain = _run(DDPEngine(
         model, SGD(), make_mesh(MeshSpec(data=8)), donate=False

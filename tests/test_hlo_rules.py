@@ -1003,3 +1003,114 @@ def test_exemptions_report_but_do_not_count():
     found = [f for f in run_rules(ctx) if f.rule == "donated-step-aliased"]
     assert found and found[0].exempted
     assert "lowering-only" in found[0].exemption_reason
+
+
+# ------------------------------------------- plan-* fabric rules
+
+
+def plan_target(**kw):
+    """Canned composed-plan target: a 2x2x2 PP x SP x DP plan whose
+    traced collective inventory is exactly the contract — one
+    plan_wire ppermute on ('stage',), one kv_ring hop on ('seq',),
+    one fused plan_grad psum over all three axes."""
+    base = dict(
+        name="t", engine="plan",
+        data_axes=("data",), ici_axis="data", ici_size=2,
+        plan_axes=(("stage", 2), ("data", 2), ("seq", 2)),
+        plan_collective_records=(
+            ("ppermute", ("stage",), "f32",
+             "jit(f)/plan_wire/ppermute", 64),
+            ("ppermute", ("seq",), "f32",
+             "jit(f)/kv_ring/ppermute", 64),
+            ("psum", ("stage", "data", "seq"), "f32",
+             "jit(f)/plan_grad/psum", 64),
+        ),
+    )
+    base.update(kw)
+    return LintTarget(**base)
+
+
+@pytest.mark.hlo_rule("plan-wire-fabric", "positive")
+def test_plan_wire_fires_off_stage_axis():
+    # The activation wire riding 'data' instead of 'stage' — the
+    # composition put pipeline traffic on the wrong fabric.
+    t = plan_target(plan_collective_records=(
+        ("ppermute", ("data",), "f32",
+         "jit(f)/plan_wire/ppermute", 64),
+        ("psum", ("stage", "data", "seq"), "f32",
+         "jit(f)/plan_grad/psum", 64),
+    ))
+    found = check("plan-wire-fabric", t, module([]), MESH8)
+    assert found and "('stage',)" in found[0].message
+    # Vacuity guard: a pp>1 plan with NO wire records also fires.
+    t2 = plan_target(plan_collective_records=(
+        ("psum", ("stage", "data", "seq"), "f32",
+         "jit(f)/plan_grad/psum", 64),
+    ))
+    found2 = check("plan-wire-fabric", t2, module([]), MESH8)
+    assert found2 and "not checked" in found2[0].message
+
+
+@pytest.mark.hlo_rule("plan-wire-fabric", "negative")
+def test_plan_wire_stage_only_clean():
+    assert check(
+        "plan-wire-fabric", plan_target(), module([]), MESH8
+    ) == []
+
+
+@pytest.mark.hlo_rule("plan-seq-fabric", "positive")
+def test_plan_seq_fires_on_ring_off_seq_axis():
+    # A kv_ring hop crossing 'stage' — the ring attention rotation
+    # left the ICI fabric.
+    t = plan_target(plan_collective_records=(
+        ("ppermute", ("stage",), "f32",
+         "jit(f)/plan_wire/ppermute", 64),
+        ("ppermute", ("stage",), "f32",
+         "jit(f)/kv_ring/ppermute", 64),
+        ("psum", ("stage", "data", "seq"), "f32",
+         "jit(f)/plan_grad/psum", 64),
+    ))
+    found = check("plan-seq-fabric", t, module([]), MESH8)
+    assert found and "('seq',)" in found[0].message
+
+
+@pytest.mark.hlo_rule("plan-seq-fabric", "negative")
+def test_plan_seq_rings_on_seq_clean():
+    assert check(
+        "plan-seq-fabric", plan_target(), module([]), MESH8
+    ) == []
+
+
+@pytest.mark.hlo_rule("plan-grad-fabric", "positive")
+def test_plan_grad_fires_on_partial_axis_psum():
+    # A per-axis cascade ('data'-only psum under plan_grad) instead
+    # of the single fused three-axis rendezvous.
+    t = plan_target(plan_collective_records=(
+        ("ppermute", ("stage",), "f32",
+         "jit(f)/plan_wire/ppermute", 64),
+        ("psum", ("data",), "f32", "jit(f)/plan_grad/psum", 64),
+    ))
+    found = check("plan-grad-fabric", t, module([]), MESH8)
+    assert found and "fused psum" in found[0].message
+    # An FSDP weight gather off the 'data' axis fires too.
+    t2 = plan_target(plan_collective_records=(
+        ("psum", ("stage", "data", "seq"), "f32",
+         "jit(f)/plan_grad/psum", 64),
+        ("all_gather", ("seq",), "f32",
+         "jit(f)/plan_fsdp_gather/all_gather", 64),
+    ))
+    found2 = check("plan-grad-fabric", t2, module([]), MESH8)
+    assert found2 and "plan_fsdp_gather" in found2[0].message
+
+
+@pytest.mark.hlo_rule("plan-grad-fabric", "negative")
+def test_plan_grad_fused_psum_and_data_gather_clean():
+    t = plan_target(plan_collective_records=(
+        ("ppermute", ("stage",), "f32",
+         "jit(f)/plan_wire/ppermute", 64),
+        ("psum", ("stage", "data", "seq"), "f32",
+         "jit(f)/plan_grad/psum", 64),
+        ("all_gather", ("data",), "f32",
+         "jit(f)/plan_fsdp_gather/all_gather", 64),
+    ))
+    assert check("plan-grad-fabric", t, module([]), MESH8) == []

@@ -153,9 +153,13 @@ def test_profiler_trace_captured(tmp_path):
     )
 
 
+@pytest.mark.slow
 def test_pipeline_bf16_stage_local_combo():
     """The two pipeline options compose: bf16 activations/wire WITH
-    stage-local (1/S-sharded) parameter storage."""
+    stage-local (1/S-sharded) parameter storage. `slow` (tier-1
+    budget); tier-1 twins: test_pipeline_bf16_close_to_f32 (the bf16
+    half) + test_pipeline's stage-local storage pins (the sharding
+    half)."""
     mesh = make_mesh(MeshSpec(data=2, stage=4))
     stages = tinycnn.split_stages(4, 10)
     f32 = PipelineEngine(

@@ -118,7 +118,13 @@ def _assert_decode_parity(eng, dense, *, steps=3, rtol=1e-5, atol=1e-6):
 # ------------------------------------------------------------- layouts
 
 
+@pytest.mark.slow
 def test_decode_matches_dense_replicated(dense):
+    """`slow` (tier-1 budget); tier-1 twins:
+    test_serving_paged.test_paged_decode_matches_dense_replicated (the
+    same replicated decode-vs-dense parity through the paged pool —
+    the serving hot path since ISSUE 15) + the tp/sp layout parities
+    below."""
     eng = ServingEngine(CFG, num_slots=4, max_len=16, prefill_len=8)
     _assert_decode_parity(eng, dense)
 

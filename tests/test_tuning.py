@@ -252,6 +252,89 @@ def test_closed_form_argmin_never_worse_than_hand_rows():
     assert moe_knobs["dispatch"] == "hierarchical"
 
 
+# -------------------------------------------- the composed-plan family
+
+
+def test_plan_grid_agrees_with_parse_plan():
+    """THE drift pin `space.py` promises: the tuner's jax-free spec
+    parse (`plan_spec_axes`) and the engine's grammar
+    (`parallel.plan.parse_plan`) agree on every grid spec, and each
+    spec round-trips through `ParallelPlan.spec` byte-for-byte — the
+    tuner can never emit a plan string `build_plan_engine` refuses."""
+    from distributed_model_parallel_tpu.parallel.plan import parse_plan
+
+    grid = space._PLAN_GRID
+    assert len(grid) == len(set(grid)) == 16 + 49  # S8 + S64
+    for spec in grid:
+        p = parse_plan(spec)
+        ax = space.plan_spec_axes(spec)
+        assert (ax["pp"], ax["sp"], ax["dp"], ax["ep"], ax["fsdp"]) \
+            == (p.pp, p.tp_or_sp, p.dp, p.ep, p.fsdp), spec
+        assert ax["pp"] * ax["sp"] * ax["dp"] == p.num_devices
+        assert p.spec == spec
+    # and both sides refuse the same malformed tokens
+    for bad in ("zz4", "pp2xpp2", "pp2x"):
+        with pytest.raises(ValueError):
+            space.plan_spec_axes(bad)
+
+
+def test_plan_candidates_mesh_and_dcn_filtering():
+    """`size` gates the grid to the cell's mesh; dcn > 1 drops the
+    factorizations whose ring-attention hops would cross the slice
+    boundary (the stage wire is the only collective a plan may send
+    over DCN). Enumeration is deterministic — the order is the
+    tie-break substrate plangate's byte-stability rides on."""
+    s8 = space.candidates("plan", 1, size=8)
+    assert len(s8) == 16
+    assert all(
+        ax["pp"] * ax["sp"] * ax["dp"] == 8
+        for ax in (space.plan_spec_axes(k["plan"]) for k in s8)
+    )
+    assert s8 == space.candidates("plan", 1, size=8)
+    # dcn2 @64: sp64 is the one spec whose ring would cross DCN
+    s64 = space.candidates("plan", 2, size=64)
+    assert len(s64) == 48
+    assert all(
+        space.plan_spec_axes(k["plan"])["sp"] <= 32 for k in s64
+    )
+    assert {k["plan"] for k in space.candidates("plan", 1, size=64)} \
+        - {k["plan"] for k in s64} == {"sp64"}
+    # a size with no grid points yields an empty (not erroring) cell
+    assert space.candidates("plan", 1, size=16) == []
+
+
+def test_plan_closed_form_argmin_never_worse_than_hand_rows():
+    """scaling64 §3f without importing experiments/: every single-axis
+    plan is a point in the composed space, so the plan argmin's
+    predicted step is <= each hand-picked factorization's."""
+    from distributed_model_parallel_tpu.observability import cost
+    from distributed_model_parallel_tpu.tuning.search import (
+        closed_form_argmin, plan_closed_form_s,
+    )
+
+    payload = {
+        "grad_bytes": 939_524_096, "mb": 8, "seq_len": 2048,
+        "dim": 1024, "vocab": 32768, "n_layers": 16,
+    }
+    ici, dcn = 32, 2
+    knobs, argmin_s = closed_form_argmin("plan", payload, ici, dcn)
+    ax = space.plan_spec_axes(knobs["plan"])  # argmin IS a legal spec
+    assert ax["pp"] * ax["sp"] * ax["dp"] == ici * dcn
+    for spec in ("dp64", "fsdp64", "pp2xdp32", "pp2xsp2xdp16"):
+        hand_s = cost.composed_plan_step_s(
+            *(lambda a: (a["pp"], a["sp"], a["dp"]))(
+                space.plan_spec_axes(spec)),
+            payload["grad_bytes"], payload["mb"], payload["seq_len"],
+            payload["dim"], payload["vocab"], payload["n_layers"],
+            ici, dcn, fsdp=space.plan_spec_axes(spec)["fsdp"],
+        )
+        assert argmin_s <= hand_s * (1 + 1e-9), spec
+        # plan_closed_form_s is exactly the cost row (one pricing path)
+        assert plan_closed_form_s(
+            {"plan": spec}, payload, ici, dcn
+        ) == hand_s
+
+
 # -------------------------------------------------------- CLI guards
 
 
@@ -345,6 +428,7 @@ def test_auto_tune_plan_file_applies_knobs(tmp_path):
     assert args.dcn_compression == "bf16"
 
 
+@pytest.mark.slow
 def test_lm_auto_tune_search_applies_and_lints_clean(
     tmp_path, monkeypatch
 ):
@@ -353,8 +437,11 @@ def test_lm_auto_tune_search_applies_and_lints_clean(
     configuration lints CLEAN under the full hlolint registry (the
     search refuses to emit otherwise), the knobs land on args in the
     shapes the existing guards expect, and the plan round-trips
-    through --auto-tune-out. Finalists clamped to 1 here for tier-1
-    budget; the slow lm e2e drives the full default search."""
+    through --auto-tune-out. Finalists clamped to 1 here; the slow lm
+    e2e drives the full default search. `slow` (tier-1 budget);
+    tier-1 twins: test_search_determinism_bruteforce_and_lint (search
+    + lint machinery) + test_auto_tune_explicit_flag_guards (the CLI
+    apply surface) + the plangate gate tests (emitted-plan drift)."""
     import functools
 
     from distributed_model_parallel_tpu.cli import lm

@@ -488,14 +488,18 @@ def test_fsdp_compressed_gather_hops_ride_the_wire(devices):
             assert "dcn_wire" in scope or "dcn_scale" in scope, scope
 
 
-@pytest.mark.parametrize("wire", _WIRE_SWEEP)
+@pytest.mark.parametrize("wire", _WIRE_SWEEP_SLOW)
 def test_fsdp_compressed_matches_f32_and_stays_sharded(wire, devices):
     """FSDP: monolithic (single-flat-bucket explicit step) + bucketed +
     overlapped with a compressed wire — trajectory within budget AND
     the 1/N at-rest sharding of params + moments preserved. Since
     ISSUE 16 the WEIGHT gathers ride the codec too (every forward sees
     one codec crossing per cross-slice weight block), so this budget
-    now covers both compressed legs."""
+    now covers both compressed legs. `slow` (tier-1 budget); tier-1
+    twins: test_ddp_compressed_matches_f32_all_modes[int8] (same
+    bucketing + wire machinery), test_fsdp_coded_gather_layout_matches_fused
+    + test_fsdp_compressed_gather_hops_ride_the_wire (the fsdp-specific
+    coded gather leg and its hop multiset)."""
     from distributed_model_parallel_tpu.parallel.fsdp import FSDPEngine
     from distributed_model_parallel_tpu.training.optim import AdamW
 
@@ -572,12 +576,16 @@ def test_causal_lm_sp_compressed_matches_f32(wire, devices):
         )
 
 
-@pytest.mark.parametrize("wire", _WIRE_SWEEP)
+@pytest.mark.parametrize("wire", _WIRE_SWEEP_SLOW)
 def test_ep_compressed_dispatch_matches_f32(wire, devices):
     """Compressed hierarchical MoE dispatch (unfused + overlapped) vs
     the f32 hierarchical control on the 2x4 hybrid fabric: the
     activations cross the codec here, so the budget is the wire's, and
-    unfused == overlapped EXACTLY (same codec applications)."""
+    unfused == overlapped EXACTLY (same codec applications). `slow`
+    (tier-1 budget); tier-1 twins:
+    test_moe_dcn_hops_dtype_pinned_from_jaxpr (every dispatch dcn hop's
+    wire dtype) + test_ddp_compressed_matches_f32_all_modes[int8] (the
+    codec numerics on the grad path)."""
     from distributed_model_parallel_tpu.analysis.lint import (
         moe_classifier,
     )
