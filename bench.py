@@ -695,11 +695,13 @@ def _tuned_row(axis_size: int, knobs, combo, tuned_ms: float,
 
 def run_child_plan_bench(max_devices: int, platform: str = "cpu",
                          plan_path=None) -> None:
-    """Composed-ParallelPlan microbench (parallel/plan.py, ISSUE 19):
-    one tiny-GPT train step per mesh factorization of the device
-    world — the pure-data plan (the table's default leg) against the
-    pp2/sp2 composed factorizations, the SAME spec strings the
-    training CLI's `--plan` takes, all through build_plan_engine.
+    """Composed-ParallelPlan microbench (parallel/plan.py, ISSUE
+    19/20): one tiny-GPT train step per mesh factorization of the
+    device world — the pure-data plan (the table's default leg)
+    against the pp2/sp2 composed factorizations, plus the SCHEDULE
+    column: gpipe vs 1f1b vs int2 twins of one pp2 plan at fixed
+    M=4, the SAME spec strings the training CLI's `--plan` takes,
+    all through build_plan_engine.
     Every row carries the alpha-beta prediction for ITS factorization
     (`cost.composed_plan_step_s` — wire + seq-ring + fused-psum legs)
     and, when the committed ledger has the matching plan/S combo, the
@@ -749,10 +751,11 @@ def run_child_plan_bench(max_devices: int, platform: str = "cpu",
     rng = np.random.RandomState(0)
     ids = rng.randint(1, 61, size=(batch, 16)).astype(np.int32)
 
-    def _time_spec(spec: str) -> dict:
+    def _time_spec(spec: str, m: int = None) -> dict:
         plan = parse_plan(spec)
         engine = build_plan_engine(
             cfg, SGD(), plan, devices=devices[:size], donate=False,
+            num_microbatches=m,
         )
         state = engine.init_state(jax.random.PRNGKey(0))
         sids, stg = engine.shard_batch(ids)
@@ -772,31 +775,56 @@ def run_child_plan_bench(max_devices: int, platform: str = "cpu",
                 engine.to_canonical(state.params)
             )
         )
-        mb = batch // (plan.dp * plan.pp)  # rows per microbatch
+        # Schedule-aware microbatch count: the engine defaults M to
+        # pp*V chunks for the interleaved schedule, pp otherwise.
+        n_mb = m or plan.pp * (
+            plan.virtual_stages if plan.schedule == "interleaved"
+            else 1
+        )
+        mb = batch // (plan.dp * n_mb)  # rows per microbatch
+        shards = plan.pp * plan.tp_or_sp * plan.dp
+        compute_s = cost.plan_step_compute_s(
+            grad_bytes // 4, batch * 16, shards,
+        )
         pred_s = cost.composed_plan_step_s(
             plan.pp, plan.tp_or_sp, plan.dp, grad_bytes, mb=mb,
             seq_len=16, dim=cfg.dim, vocab=cfg.vocab_size,
             n_layers=cfg.num_layers, ici=size, dcn=1,
-            fsdp=plan.fsdp,
+            fsdp=plan.fsdp, schedule=plan.schedule,
+            virtual_stages=plan.virtual_stages,
+            num_microbatches=m or 0, compute_s=compute_s,
         )
+        # The ledger twin carries the M suffix when the row pins one
+        # (lint Combo names append /M<n> for explicit microbatches).
+        combo_name = f"plan/S{size}/{spec}" + (f"/M{m}" if m else "")
         return _with_predicted(
             {
                 "plan": spec,
+                "schedule": plan.schedule,
                 "axes": {"pp": plan.pp, "sp": plan.tp_or_sp,
-                         "dp": plan.dp, "fsdp": plan.fsdp},
+                         "dp": plan.dp, "fsdp": plan.fsdp,
+                         "virtual": plan.virtual_stages},
+                "microbatches": n_mb,
                 "step_ms": round(step_ms, 3),
                 "model_predicted_ms": round(pred_s * 1e3, 4),
             },
-            f"plan/S{size}/{spec}", measured_key="step_ms",
+            combo_name, measured_key="step_ms",
         )
 
     specs = [
-        f"dp{size}", f"pp2xdp{size // 2}", f"sp2xdp{size // 2}",
-        f"pp2xsp2xdp{size // 4}",
+        (f"dp{size}", None), (f"pp2xdp{size // 2}", None),
+        (f"sp2xdp{size // 2}", None),
+        (f"pp2xsp2xdp{size // 4}", None),
+        # The schedule column (ISSUE 20): gpipe vs 1f1b vs int2 twins
+        # of ONE factorization at fixed pp2 x M=4 — same mesh, same
+        # collectives, different tick program; the ledger twins are
+        # the /M4 combos the lint matrix pins.
+        (f"pp2xdp{size // 2}", 4), (f"pp2-1f1bxdp{size // 2}", 4),
+        (f"pp2-int2xdp{size // 2}", 4),
     ]
     rows = []
-    for spec in specs:
-        rows.append(_time_spec(spec))
+    for spec, m in specs:
+        rows.append(_time_spec(spec, m))
         # Per-leg partial line (same convention as the other sweeps):
         # a wedge mid-sweep keeps the finished factorizations.
         print(json.dumps({"leg": rows[-1], "partial": True}), flush=True)

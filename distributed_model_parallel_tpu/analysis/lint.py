@@ -93,10 +93,17 @@ class Combo:
     speculative_k: int = 0
 
     # Composed ParallelPlan spec (engine == "plan", ISSUE 19): the
-    # `parse_plan` spec string (e.g. "pp2xsp2xdp2") the builder lowers
-    # through ComposedPlanEngine. None everywhere else (every
+    # `parse_plan` spec string (e.g. "pp2xsp2xdp2", or the scheduled
+    # "pp2-1f1bxdp4" / "pp2-int2xdp2" forms, ISSUE 20) the builder
+    # lowers through ComposedPlanEngine. None everywhere else (every
     # pre-existing combo name and ledger row stays byte-stable).
     plan: Optional[str] = None
+
+    # Pipeline fill depth for plan combos (ISSUE 20): 0 keeps the
+    # engine default (M = pp * V — every pre-existing plan combo name
+    # and ledger row byte-stable); set = the tuner's M knob, which the
+    # bubble-factor compute fold (`cost.add_plan_compute`) prices.
+    num_microbatches: int = 0
 
     @property
     def name(self) -> str:
@@ -111,6 +118,8 @@ class Combo:
                 bits.append("ov")
         if self.plan is not None:
             bits.append(self.plan)
+        if self.num_microbatches:
+            bits.append(f"M{self.num_microbatches}")
         if self.dcn_compression != "none":
             bits.append(f"wire-{self.dcn_compression}")
         if self.bucket_mb is not None:
@@ -1184,15 +1193,18 @@ def _build_plan(combo: Combo, devices):
         devices=devices[: plan.num_devices],
     )
     cfg = _gpt_cfg()
-    if cfg.num_layers % plan.pp:
-        # Deep-pipeline specs (pp8 at S8) need a stage-divisible stack;
-        # widen the proxy to pp layers — the same proxy-fits-the-grid
-        # compromise as space._BUCKET_GRID's sub-MB values.
+    chunks = plan.pp * plan.virtual_stages
+    if cfg.num_layers % chunks:
+        # Deep-pipeline specs (pp8 at S8) and interleaved ones need a
+        # chunk-divisible stack; widen the proxy to pp*V layers — the
+        # same proxy-fits-the-grid compromise as space._BUCKET_GRID's
+        # sub-MB values. `cost.plan_combo_compute_s` mirrors this.
         import dataclasses as _dc
 
-        cfg = _dc.replace(cfg, num_layers=plan.pp)
+        cfg = _dc.replace(cfg, num_layers=chunks)
     eng = ComposedPlanEngine(
-        cfg, SGD(), mesh, plan, min_shard_elems=64
+        cfg, SGD(), mesh, plan, min_shard_elems=64,
+        num_microbatches=combo.num_microbatches or None,
     )
     ts = eng.init_state(jax.random.PRNGKey(0))
     rng = np.random.RandomState(0)
@@ -1213,6 +1225,8 @@ def _build_plan(combo: Combo, devices):
             ("seq", plan.tp_or_sp),
         ),
         plan_collective_records=records,
+        plan_schedule=plan.schedule,
+        plan_virtual=plan.virtual_stages,
         n_param_leaves=_n_param_leaves(ts),
         **_mesh_facts(mesh),
     )
@@ -1336,6 +1350,23 @@ def full_matrix() -> List[Combo]:
     # rides in via pregate_matrix().)
     combos.append(Combo("plan", 8, plan="pp2xsp2xdp2"))
     combos.append(Combo("plan", 8, plan="pp2xsp2xfsdp2"))
+    # Scheduled tick programs (ISSUE 20): the 1f1b 3-axis plan, the
+    # interleaved V=2 plan over the fsdp per-parameter layout, and the
+    # plangate sched cell's gpipe/1f1b twins at M=4 (M just above pp)
+    # — plan-wire-fabric pins the per-schedule static ppermute count,
+    # and the M4 rows are what bench.py --plan-microbench reconciles
+    # its schedule column against.
+    combos.append(Combo("plan", 8, plan="pp2-1f1bxsp2xdp2"))
+    combos.append(Combo("plan", 8, plan="pp2-int2xfsdp4"))
+    combos.append(
+        Combo("plan", 8, plan="pp2xdp4", num_microbatches=4)
+    )
+    combos.append(
+        Combo("plan", 8, plan="pp2-1f1bxdp4", num_microbatches=4)
+    )
+    combos.append(
+        Combo("plan", 8, plan="pp2-int2xdp4", num_microbatches=4)
+    )
     combos.append(Combo("tp", 4, collective_matmul=True, bf16=True))
     combos.append(Combo("sp", 4, collective_matmul=True, bf16=True))
     # MoE dispatch (PR 10): the GSPMD 'expert'-axis baseline plus the

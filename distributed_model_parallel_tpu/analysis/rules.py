@@ -176,6 +176,13 @@ class LintTarget:
     plan_collective_records: Tuple[
         Tuple[str, Tuple[str, ...], str, str, int], ...
     ] = ()
+    # The plan's pipeline schedule (ISSUE 20): keys the plan-wire-
+    # fabric rule's static ppermute-count pin (gpipe traces forward +
+    # transpose; a scheduled plan traces the tick program's up + down
+    # wires — and NEVER more, because schedules replay TABLES inside
+    # one scan rather than unrolling per-tick programs).
+    plan_schedule: str = "gpipe"
+    plan_virtual: int = 1
 
     # rule_id -> reason; the finding is reported but not counted
     # (module docstring).
@@ -1147,15 +1154,27 @@ def _collective_fabric_known(ctx: LintContext) -> List[Finding]:
 PLAN_SEQ_SCOPE_WORDS = ("kv_ring", "ag_matmul", "matmul_rs")
 
 
+# Static plan_wire ppermute count per pipeline schedule (ISSUE 20).
+# Both tick programs trace exactly TWO stage ppermutes: gpipe's
+# forward hop + its autodiff transpose, a scheduled plan's up + down
+# wires inside the one table-replayed tick body. The pin is the
+# table-driven-replay contract itself — an unrolled schedule (or a
+# per-tick lax.switch lowering) would multiply this count by the tick
+# count.
+PLAN_WIRE_PPERMUTES = {"gpipe": 2, "1f1b": 2, "interleaved": 2}
+
+
 @rule(
     id="plan-wire-fabric", severity="error", source="ISSUE 19",
     contract=(
         "A composed plan's pipeline wire rides the stage fabric (the "
         "plan mesh's DCN contract) and nothing else: every "
         "`plan_wire`-scoped collective in the traced step is a "
-        "ppermute over exactly ('stage',), and a pp>1 plan must "
-        "trace at least one (the forward hop; its transpose rides "
-        "the same scope)."
+        "ppermute over exactly ('stage',), and a pp>1 plan traces "
+        "the schedule's exact static count (PLAN_WIRE_PPERMUTES: "
+        "gpipe = forward + transpose; 1f1b/interleaved = the tick "
+        "table's up + down wires) — more means the schedule unrolled "
+        "instead of replaying its table."
     ),
     applies=lambda t: t.engine == "plan",
 )
@@ -1181,6 +1200,16 @@ def _plan_wire_fabric(ctx: LintContext) -> List[Finding]:
                 f"{dt}, scope {scope!r}) — the activation wire is a "
                 "ppermute over ('stage',) only",
             ))
+    expected = PLAN_WIRE_PPERMUTES.get(t.plan_schedule)
+    if (axes_of.get("stage", 1) > 1 and expected is not None
+            and len(wire) != expected):
+        out.append(ctx.finding(
+            "plan-wire-fabric",
+            f"{len(wire)} plan_wire ppermute(s) traced under the "
+            f"{t.plan_schedule!r} schedule — the tick program pins "
+            f"exactly {expected} (table-driven replay, not an "
+            "unrolled per-tick program)",
+        ))
     return out
 
 

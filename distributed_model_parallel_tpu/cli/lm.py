@@ -143,9 +143,12 @@ def build_parser() -> argparse.ArgumentParser:
                         "--seq-shards)")
     p.add_argument("--plan", default=None, metavar="SPEC|auto",
                    help="composed ParallelPlan spec (parallel/plan.py, "
-                        "ISSUE 19): one declarative mesh factorization "
-                        "— tokens ppN/spN/dpN/fsdpN joined by 'x', e.g. "
-                        "pp2xsp2xdp2 or fsdp8 — driven through "
+                        "ISSUE 19/20): one declarative mesh "
+                        "factorization — tokens ppN/spN/dpN/fsdpN "
+                        "joined by 'x', e.g. pp2xsp2xdp2 or fsdp8; the "
+                        "pp token takes a schedule suffix (pp2-1f1b, "
+                        "pp4-int2 for interleaved with V=2 virtual "
+                        "stages; default gpipe) — driven through "
                         "build_plan_engine (degenerate specs route to "
                         "the single-axis engines). Replaces the "
                         "per-axis flags (--pipeline-stages, "
@@ -218,10 +221,11 @@ def main(argv=None) -> dict:
             )
         if args.pipeline_schedule != "gpipe" or args.virtual_stages != 1:
             raise SystemExit(
-                f"plan {plan.spec} runs the composed gpipe tick "
-                "program over its pp field; --pipeline-schedule "
-                "1f1b/interleaved and --virtual-stages ride "
-                "--pipeline-stages, not --plan"
+                f"plan {plan.spec}: ParallelPlan.schedule rides the "
+                "pp token's suffix (--plan pp2-1f1b, pp4-int2); "
+                "--pipeline-schedule and --virtual-stages ride "
+                "--pipeline-stages, not --plan — drop the flags and "
+                "spell the schedule in the spec"
             )
         if args.microbatches != 1 and plan.pp <= 1:
             raise SystemExit(
@@ -239,9 +243,9 @@ def main(argv=None) -> dict:
         if args.moe_experts > 0:
             raise SystemExit(
                 f"--moe-experts trains under the expert-parallel "
-                f"engine, but plan {plan.spec} has ep=1 — pp/sp/fsdp "
-                "x ep plans are not built (ROADMAP item 1); drop "
-                "--plan or --moe-experts"
+                f"engine, but plan {plan.spec} has ParallelPlan.ep=1 "
+                "and ep composition is not built — drop --plan or "
+                "--moe-experts"
             )
         if args.attention != "ring" and plan.tp_or_sp <= 1:
             raise SystemExit(
@@ -449,8 +453,11 @@ def main(argv=None) -> dict:
                 f"--plan {plan.spec} needs {plan.num_devices} "
                 f"device(s), {n_dev} present"
             )
+        # The engine's default M mirrors this: pp*V chunks for the
+        # interleaved schedule, pp otherwise.
         plan_mb = (
-            args.microbatches if args.microbatches != 1 else plan.pp
+            args.microbatches if args.microbatches != 1
+            else plan.pp * plan.virtual_stages
         )
         if args.batch_size % max(plan.dp * plan_mb, 1):
             raise SystemExit(

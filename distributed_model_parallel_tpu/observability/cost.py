@@ -239,36 +239,76 @@ def two_level_all_reduce_s(nbytes: float, ici: int, dcn: int,
     return beta + alpha
 
 
+def plan_bubble_factor(pp: int, schedule: str = "gpipe",
+                       virtual_stages: int = 1,
+                       num_microbatches: int = 0) -> float:
+    """Pipeline-span stretch over ideal per-device compute (Narayanan
+    et al. SC'21): (V*M + pp - 1) / (V*M). gpipe and 1F1B share the
+    fill-drain span (1F1B buys MEMORY, not ticks — the O(S) stash);
+    interleaving (V virtual stages per device) divides the bubble by
+    V. M defaults to the engine's own default (pp, or pp*V
+    interleaved); pp <= 1 has no bubble."""
+    if pp <= 1:
+        return 1.0
+    v = virtual_stages if schedule == "interleaved" else 1
+    m = num_microbatches or pp * v
+    return (v * m + pp - 1.0) / (v * m)
+
+
 def composed_plan_step_s(pp: int, sp: int, dp: int,
                          grad_bytes: float, mb: int, seq_len: int,
                          dim: int, vocab: int, n_layers: int,
                          ici: int, dcn: int,
                          fsdp: bool = False,
                          constants: Optional[Dict[str, float]] = None,
+                         schedule: str = "gpipe",
+                         virtual_stages: int = 1,
+                         num_microbatches: int = 0,
+                         compute_s: float = 0.0,
                          ) -> float:
     """Asked-bytes step time of one composed `ParallelPlan` training
-    step (ISSUE 19, `parallel/plan.py`), the plan family's closed form.
-    Three collective legs, each pinned to its fabric by the hlolint
-    plan-* rules:
+    step (ISSUE 19/20, `parallel/plan.py`), the plan family's closed
+    form. Three collective legs, each pinned to its fabric by the
+    hlolint plan-* rules:
 
-    wire — the gpipe stage handoff (`plan_wire` ppermute): 2*pp-1
-      ticks, each moving one microbatch activation pair
+    wire — the stage handoff (`plan_wire` ppermute). gpipe: M + pp - 1
+      forward ticks (the backward transpose rides the same count),
+      each moving one microbatch activation pair
       mb x (seq_len/sp) x max(dim, vocab) floats to the next stage.
-      Stages are laid across 'dcn' when the fabric is factored
-      (the plan grid admits pp>1 at dcn>1 only when the slice boundary
-      falls between stages), else ICI.
+      A scheduled plan (1f1b / interleaved, ISSUE 20) replays its tick
+      TABLE: 2*M*V + 2*(pp-1) ticks with an explicit backward wire —
+      scheduling trades MORE wire ticks for a smaller compute bubble,
+      which is exactly the tradeoff the tuner prices. Stages are laid
+      across 'dcn' when the fabric is factored (the plan grid admits
+      pp>1 at dcn>1 only when the slice boundary falls between
+      stages), else ICI.
     seq — ring-attention KV hops over 'seq' (sp-1 ppermutes of the
-      mb x (seq_len/sp) x dim K and V shards per layer) inside every
-      tick's stage slice: ICI always (plan-seq-fabric pins it).
+      mb x (seq_len/sp) x dim K and V shards per chunk) inside every
+      tick's chunk slice (n_layers / (pp*V) layers): ICI always
+      (plan-seq-fabric pins it).
     grad — ONE fused gradient psum over ('stage','data','seq')
       (`plan_grad`): multislice XLA decomposes a global all-reduce
       hierarchically, so at dcn>1 it prices as the two-level form over
       (group/dcn) x dcn, else a flat ring over the whole group.
     fsdp adds the per-step param all-gather (`plan_fsdp_gather`) over
       'data' — DCN-facing only when the data axis is what crosses the
-      slice boundary (pp == 1)."""
+      slice boundary (pp == 1).
+    compute_s (optional) — the plan's ideal per-device step compute
+      (`plan_step_compute_s`), folded in under `plan_bubble_factor`:
+      the term the schedule knob actually shrinks. 0 keeps the
+      comm-only form (every pre-ISSUE-20 caller prices identically).
+
+    `num_microbatches=0` means the engine default (M = pp, or pp*V
+    interleaved) — under which the gpipe wire tick count is the
+    historical 2*pp - 1."""
     bw_ici, a_ici, bw_dcn, a_dcn = _resolve_constants(constants)
-    ticks = 2 * pp - 1
+    v = virtual_stages if schedule == "interleaved" else 1
+    m = num_microbatches or pp * v
+    scheduled = schedule != "gpipe" and pp > 1
+    if scheduled:
+        ticks = 2 * m * v + 2 * (pp - 1)
+    else:
+        ticks = m + pp - 1  # == 2*pp - 1 at the default M = pp
     total = 0.0
     if pp > 1:
         wire_bytes = mb * (seq_len // sp) * max(dim, vocab) * 4
@@ -277,7 +317,7 @@ def composed_plan_step_s(pp: int, sp: int, dp: int,
     if sp > 1:
         kv_bytes = 2 * mb * (seq_len // sp) * dim * 4
         total += (
-            ticks * (n_layers // pp) * (sp - 1)
+            ticks * (n_layers // (pp * v)) * (sp - 1)
             * (a_ici + kv_bytes / bw_ici)
         )
     group = pp * sp * dp
@@ -297,7 +337,31 @@ def composed_plan_step_s(pp: int, sp: int, dp: int,
             else (bw_ici, a_ici)
         )
         total += (dp - 1) * a + (dp - 1) / dp * grad_bytes / bw
+    if compute_s:
+        total += compute_s * plan_bubble_factor(
+            pp, schedule, virtual_stages, num_microbatches
+        )
     return total
+
+
+def plan_step_compute_s(n_params: float, tokens: float, shards: int,
+                        mode: str = "f32",
+                        constants: Optional[
+                            Dict[str, float]] = None) -> float:
+    """Ideal per-device arithmetic of one dense train step: the
+    standard 6 flop per parameter per token (2 forward + 4 backward),
+    split over the plan's pp*sp*dp shards, at the MXU rate — training
+    GEMMs are large, so unlike decode (`quant_matmul_s`) the weight
+    stream amortizes and the MXU bound is the one that binds."""
+    if mode not in MXU_RATE:
+        raise ValueError(
+            f"mode must be one of {sorted(MXU_RATE)}, got {mode!r}"
+        )
+    c = _resolve_compute_constants(constants)
+    return (
+        6.0 * n_params * tokens / shards
+        / c[f"mxu_{mode}_flop_per_s"]
+    )
 
 
 def flat_all_to_all_s(elems: int, itemsize: int, ici: int,
@@ -730,6 +794,78 @@ def combo_cost(combo, devices=None, constants=None) -> dict:
     row = breakdown.as_row()
     if combo.engine == "serve":
         row = add_serve_compute(row, combo)
+    elif combo.engine == "plan":
+        row = add_plan_compute(row, combo, constants)
+    return row
+
+
+def plan_combo_compute_s(combo,
+                         constants: Optional[
+                             Dict[str, float]] = None) -> float:
+    """The ideal (bubble-free) per-device compute of ONE lint-matrix
+    plan combo. Model facts mirror `lint._build_plan`'s proxy — the
+    `_gpt_cfg` GPT with its stack widened to a pp*V multiple, fed ids
+    of shape (4 * dp * pp, 16) — shared by `combo_cost` and the
+    tuner's lowering tier so the committed ledger and the committed
+    plans price the same form. Heavy (jax.eval_shape) but compile-free;
+    both callers have already lowered the combo."""
+    import math
+
+    import jax
+    import jax.numpy as jnp
+
+    from distributed_model_parallel_tpu.analysis.lint import _gpt_cfg
+    from distributed_model_parallel_tpu.models.gpt import gpt_lm
+    from distributed_model_parallel_tpu.tuning.space import (
+        plan_spec_axes,
+    )
+
+    ax = plan_spec_axes(combo.plan)
+    chunks = ax["pp"] * ax["virtual"]
+    cfg = _gpt_cfg()
+    if cfg.num_layers % chunks:
+        cfg = dataclasses.replace(cfg, num_layers=chunks)
+    key_aval = jax.ShapeDtypeStruct((2,), jnp.uint32)
+    p_aval, _ = jax.eval_shape(gpt_lm(cfg).init, key_aval)
+    n_params = sum(
+        int(math.prod(leaf.shape) or 1)
+        for leaf in jax.tree_util.tree_leaves(p_aval)
+    )
+    tokens = 4 * ax["dp"] * ax["pp"] * cfg.max_position
+    shards = ax["pp"] * ax["sp"] * ax["dp"]
+    return plan_step_compute_s(
+        n_params, tokens, shards, constants=constants
+    )
+
+
+def add_plan_compute(row: dict, combo,
+                     constants: Optional[
+                         Dict[str, float]] = None) -> dict:
+    """Fold the train-compute roofline into one plan ledger row
+    (ISSUE 20) — gpipe combos too, so the cross-schedule deltas are
+    visible in the committed ledger. The lowered comm breakdown in
+    `row` prices each STATIC collective once, which is identical
+    across schedules (the scheduled program has the same gather /
+    wire / fused-psum inventory as its gpipe twin by construction);
+    the bubble-stretched compute term is what the schedule knob
+    actually moves, so it is the differentiator `predicted_step_s`
+    carries into the tuner's argmin."""
+    from distributed_model_parallel_tpu.tuning.space import (
+        plan_spec_axes,
+    )
+
+    compute_s = plan_combo_compute_s(combo, constants)
+    ax = plan_spec_axes(combo.plan)
+    bubble = plan_bubble_factor(
+        ax["pp"], ax["schedule"], ax["virtual"],
+        getattr(combo, "num_microbatches", 0),
+    )
+    row = dict(row)
+    row["train_compute_s"] = round(compute_s, 12)
+    row["bubble_factor"] = round(bubble, 9)
+    row["predicted_step_s"] = round(
+        row["predicted_step_s"] + compute_s * bubble, 9
+    )
     return row
 
 
@@ -804,9 +940,13 @@ __all__ = [
     "MXU_RATE",
     "SPEC_MODEL_ACCEPT",
     "WIRE_ITEMSIZE",
+    "add_plan_compute",
     "add_serve_compute",
     "combo_cost",
     "composed_plan_step_s",
+    "plan_bubble_factor",
+    "plan_combo_compute_s",
+    "plan_step_compute_s",
     "serve_combo_compute_s",
     "fabrics_from_constants",
     "flat_all_to_all_s",

@@ -92,6 +92,11 @@ from distributed_model_parallel_tpu.parallel.data_parallel import (
     _metrics,
     _place_batch,
 )
+from distributed_model_parallel_tpu.parallel.pipeline import (
+    PIPE_BWD,
+    PIPE_FWD,
+    PIPE_IDLE,
+)
 from distributed_model_parallel_tpu.parallel.sequence_parallel import (
     ATTENTION,
     _check_seq_len,
@@ -110,7 +115,13 @@ _TOKEN_FIELD = {
     "pp": "pp", "sp": "tp_or_sp", "tp": "tp_or_sp",
     "dp": "dp", "fsdp": "dp", "ep": "ep",
 }
-_TOKEN_RE = re.compile(r"^(pp|sp|tp|dp|fsdp|ep)(\d+)$")
+# The pp token optionally carries the pipeline SCHEDULE as a dashed
+# suffix: `pp2-1f1b` (PipeDream-flush), `pp4-int2` (Megatron
+# interleaved with V=2 virtual chunks per stage). No suffix = gpipe.
+_TOKEN_RE = re.compile(
+    r"^(pp|sp|tp|dp|fsdp|ep)(\d+)(?:-(1f1b|int(\d+)))?$"
+)
+PLAN_SCHEDULES = ("gpipe", "1f1b", "interleaved")
 
 
 @dataclasses.dataclass(frozen=True)
@@ -125,6 +136,12 @@ class ParallelPlan:
     dp: int = 1
     ep: int = 1
     fsdp: bool = False
+    # Pipeline schedule for the pp axis — execution-only (never part of
+    # the parameter layout): "gpipe" (fill-drain), "1f1b"
+    # (PipeDream-flush, O(S) activation stash), or "interleaved"
+    # (Megatron virtual pipeline; `virtual_stages` chunks per stage).
+    schedule: str = "gpipe"
+    virtual_stages: int = 1
 
     def __post_init__(self):
         for name in PLAN_AXES:
@@ -138,6 +155,37 @@ class ParallelPlan:
                 "ParallelPlan(fsdp=True) shards parameters over the dp "
                 f"axis; dp={self.dp} leaves nothing to shard"
             )
+        if self.schedule not in PLAN_SCHEDULES:
+            raise ValueError(
+                f"ParallelPlan.schedule must be one of "
+                f"{PLAN_SCHEDULES}, got {self.schedule!r} (the --plan "
+                "pp token sets it: pp2, pp2-1f1b, pp4-int2)"
+            )
+        if not isinstance(self.virtual_stages, int) or \
+                self.virtual_stages < 1:
+            raise ValueError(
+                "ParallelPlan.virtual_stages must be an int >= 1, got "
+                f"{self.virtual_stages!r}"
+            )
+        if self.schedule == "interleaved" and self.virtual_stages < 2:
+            raise ValueError(
+                "ParallelPlan.schedule='interleaved' needs "
+                "virtual_stages >= 2 (the --plan token spells it "
+                "pp<S>-int<V>, e.g. pp4-int2); V=1 interleaving IS "
+                "1f1b — spell it pp<S>-1f1b"
+            )
+        if self.schedule != "interleaved" and self.virtual_stages != 1:
+            raise ValueError(
+                f"ParallelPlan.virtual_stages={self.virtual_stages} "
+                f"only rides schedule='interleaved', not "
+                f"{self.schedule!r}"
+            )
+        if self.schedule != "gpipe" and self.pp < 2:
+            raise ValueError(
+                f"ParallelPlan.schedule={self.schedule!r} schedules "
+                f"the pp axis, but pp={self.pp} has no pipeline — give "
+                "the --plan a pp token >= 2 (e.g. pp2-1f1b)"
+            )
 
     @property
     def num_devices(self) -> int:
@@ -148,7 +196,12 @@ class ParallelPlan:
         """Canonical spec string (`parse_plan` round-trips it)."""
         bits = []
         if self.pp > 1:
-            bits.append(f"pp{self.pp}")
+            sched = (
+                "" if self.schedule == "gpipe"
+                else "-1f1b" if self.schedule == "1f1b"
+                else f"-int{self.virtual_stages}"
+            )
+            bits.append(f"pp{self.pp}{sched}")
         if self.tp_or_sp > 1:
             bits.append(f"sp{self.tp_or_sp}")
         if self.dp > 1 or not bits:
@@ -163,18 +216,26 @@ def parse_plan(spec: str) -> ParallelPlan:
 
     Tokens are axis-name + ways, joined by 'x': pp / sp (alias tp) /
     dp / fsdp (dp with parameter sharding) / ep. Each axis may appear
-    once; omitted axes default to 1."""
+    once; omitted axes default to 1. The pp token may carry a pipeline
+    schedule suffix — `pp2-1f1b` or `pp4-int2` (interleaved, V=2
+    chunks per stage) — default gpipe; a trailing dash before the next
+    'x' is tolerated (`pp2-1f1b-xsp2` == `pp2-1f1bxsp2`)."""
     fields: dict = {}
     fsdp = False
+    schedule, virtual = "gpipe", 1
     for token in str(spec).strip().lower().split("x"):
-        m = _TOKEN_RE.match(token.strip())
+        # The dashed schedule suffix makes `pp2-1f1b-xsp2` a natural
+        # way to write the spec; strip the dangling separator.
+        token = token.strip().rstrip("-")
+        m = _TOKEN_RE.match(token)
         if not m:
             raise ValueError(
                 f"bad plan token {token!r} in {spec!r}: expected "
-                "<axis><ways> with axis in pp/sp/tp/dp/fsdp/ep "
-                "(e.g. 'pp2xsp2xdp2', 'fsdp4')"
+                "<axis><ways> with axis in pp/sp/tp/dp/fsdp/ep and an "
+                "optional pp schedule suffix (e.g. 'pp2xsp2xdp2', "
+                "'fsdp4', 'pp2-1f1bxdp4', 'pp4-int2')"
             )
-        name, ways = m.group(1), int(m.group(2))
+        name, ways, sched_sfx = m.group(1), int(m.group(2)), m.group(3)
         field = _TOKEN_FIELD[name]
         if field in fields:
             raise ValueError(
@@ -183,7 +244,29 @@ def parse_plan(spec: str) -> ParallelPlan:
         fields[field] = ways
         if name == "fsdp":
             fsdp = True
-    return ParallelPlan(fsdp=fsdp, **fields)
+        if sched_sfx is not None:
+            if name != "pp":
+                raise ValueError(
+                    f"plan {spec!r}: the schedule suffix "
+                    f"'-{sched_sfx}' rides the pp token only "
+                    f"(ParallelPlan.schedule schedules the pipeline "
+                    f"axis), not {name!r}"
+                )
+            if sched_sfx == "1f1b":
+                schedule = "1f1b"
+            else:
+                virtual = int(m.group(4))
+                if virtual < 2:
+                    raise ValueError(
+                        f"plan {spec!r}: interleaving needs >= 2 "
+                        "virtual chunks per stage (pp<S>-int<V> with "
+                        "V >= 2); V=1 interleaving IS 1f1b — spell "
+                        "it pp<S>-1f1b"
+                    )
+                schedule = "interleaved"
+    return ParallelPlan(
+        fsdp=fsdp, schedule=schedule, virtual_stages=virtual, **fields
+    )
 
 
 def _local_sums(logits, targets):
@@ -290,24 +373,64 @@ class ComposedPlanEngine:
                 f"got {self.attention!r}"
             )
         S = plan.pp
-        M = self.num_microbatches or S
+        Vs = plan.virtual_stages
+        C = S * Vs  # logical pipeline depth (chunks across all stages)
+        M = self.num_microbatches or (
+            C if plan.schedule == "interleaved" else S
+        )
         if M < S:
             raise ValueError(
-                f"num_microbatches={M} cannot fill a {S}-stage "
-                "pipeline (need M >= pp)"
+                f"num_microbatches={M} (--microbatches) cannot fill "
+                f"a {S}-stage pipeline (need M >= ParallelPlan.pp)"
+            )
+        if plan.schedule == "interleaved" and M < C:
+            raise ValueError(
+                f"num_microbatches={M} (--microbatches) cannot fill "
+                f"the interleaved pipeline of plan {plan.spec!r}: its "
+                f"ParallelPlan.virtual_stages={Vs} runs pp*V={C} "
+                "logical chunks (need num_microbatches >= pp*V)"
             )
         self.num_microbatches = M
-        if cfg.num_layers % S:
+        if cfg.num_layers % C:
             # The uniform tick program slices a STACKED block-param
-            # tensor by stage index, so every stage must carry the
-            # same number of blocks. Uneven cuts are the single-axis
-            # pipeline's territory.
+            # tensor by (chunk, stage) index, so every logical chunk
+            # must carry the same number of blocks. Uneven cuts are
+            # the single-axis pipeline's territory.
             raise ValueError(
-                f"pp={S} must divide cfg.num_layers="
-                f"{cfg.num_layers}: the composed engine runs uniform "
-                "stage slices (uneven cuts -> "
-                "parallel/pipeline.LMPipelineEngine)"
+                f"plan {plan.spec!r} cuts the block stack into "
+                f"pp*virtual_stages={C} uniform chunks, which must "
+                f"divide cfg.num_layers={cfg.num_layers} (--layers; "
+                "uneven cuts -> parallel/pipeline.LMPipelineEngine)"
             )
+        # Scheduled tick tables (ISSUE 20): the plan's schedule field
+        # selects the tick program. gpipe keeps the autodiff fill-drain
+        # loop; 1f1b / interleaved replay the single-axis engine's
+        # static (tick, microbatch, chunk, direction) tables with a
+        # hand-scheduled per-tick vjp. The schedule is EXECUTION-ONLY:
+        # parameter layout, checkpoints, and the canonical seam are
+        # identical across schedules of the same axis factorization.
+        self._sched = None
+        self._last_sched_trace = None
+        if plan.schedule != "gpipe":
+            import numpy as np
+
+            from distributed_model_parallel_tpu.parallel.pipeline import (
+                ScheduleTicks,
+                build_1f1b_schedule,
+                build_interleaved_schedule,
+            )
+
+            if plan.schedule == "1f1b":
+                s1 = build_1f1b_schedule(S, M)
+                zc = np.zeros((s1.num_ticks, S), np.int32)
+                self._sched = ScheduleTicks(
+                    s1.work, s1.micro, zc,
+                    s1.recv_fwd, s1.recv_fwd_m, zc,
+                    s1.recv_bwd, s1.recv_bwd_m, zc,
+                    s1.num_ticks, s1.stash_depth, s1.cot_depth, 1,
+                )
+            else:
+                self._sched = build_interleaved_schedule(S, M, Vs)
         self._lm_targets = partial(
             lm_targets, pad_token_id=cfg.pad_token_id
         )
@@ -380,22 +503,24 @@ class ComposedPlanEngine:
                         return d
                 return None
 
-            def gather_params(params):
-                """ZeRO-3 weight materialization on entry: all-gather
-                each 1/dp leaf over 'data' (scope `plan_fsdp_gather`
-                for the plan-grad-fabric lint pin)."""
+            def _gather_leaf(leaf, spec, off=0):
+                """ZeRO-3 weight materialization: all-gather one 1/dp
+                leaf over 'data'. `off` shifts the sharded dim past
+                leading stack/chunk axes (the per-block gather adds
+                two)."""
+                d = _sharded_dim(spec)
+                if d is None:
+                    return leaf
+                return lax.all_gather(
+                    leaf, "data", axis=d + off, tiled=True
+                )
 
-                def gather(leaf, spec):
-                    d = _sharded_dim(spec)
-                    if d is None:
-                        return leaf
-                    return lax.all_gather(leaf, "data", axis=d,
-                                          tiled=True)
-
-                with jax.named_scope(GATHER_SCOPE):
-                    return jax.tree_util.tree_map(
-                        gather, params, pspecs
-                    )
+            # Per-parameter layout note: fsdp_specs is shape-driven
+            # and every decoder block has identical leaf shapes, so
+            # one block's spec tree describes them all — the per-block
+            # gather in gather_stage_mat reuses it on the chunk-sliced
+            # stacked rows.
+            block_pspecs = pspecs["blocks"]["0"]
 
             def slice_grads(grads):
                 """Each device keeps its own 1/dp of the fully-reduced
@@ -429,10 +554,107 @@ class ComposedPlanEngine:
                 self.optimizer.state_shardings(repl_specs, P()),
                 P(),
             )
-            gather_params = lambda p: p  # noqa: E731
+            _gather_leaf = None
+            block_pspecs = None
             slice_grads = lambda g: g  # noqa: E731
 
-        def run_ticks(params, ids, targets, step, train):
+        def gather_stage_mat(params, n_virtual):
+            """This device's execution bundle {stem, chunks, head}:
+            `chunks` leaves are (n_virtual, Lpc, ...) rows of the
+            STACKED block params for the logical chunks v*S + s_idx
+            this stage runs (n_virtual=1 is the gpipe stage slice;
+            the interleaved train path passes the plan's
+            virtual_stages). For fsdp plans the all-gather happens
+            per-BLOCK, after the chunk slice — each device
+            materializes only the blocks it executes (scope
+            `plan_fsdp_gather`) instead of the whole stack; stem and
+            head gather whole."""
+            n_chunk_layers = cfg.num_layers // (S * n_virtual)
+            s_idx = lax.axis_index("stage")
+            stacked = stack_block_params(
+                params["blocks"], cfg.num_layers
+            )
+
+            def chunk_rows(leaf):
+                return jnp.stack([
+                    lax.dynamic_slice_in_dim(
+                        leaf, (v * S + s_idx) * n_chunk_layers,
+                        n_chunk_layers, axis=0,
+                    )
+                    for v in range(n_virtual)
+                ])
+
+            chunks = jax.tree_util.tree_map(chunk_rows, stacked)
+            if not fsdp:
+                return {
+                    "stem": params["stem"], "chunks": chunks,
+                    "head": params["head"],
+                }
+            with jax.named_scope(GATHER_SCOPE):
+                chunks = jax.tree_util.tree_map(
+                    # The (chunk, layer) axes sit ahead of the leaf's
+                    # own dims: the sharded dim moved by 2.
+                    lambda lf, sp: _gather_leaf(lf, sp, 2),
+                    chunks, block_pspecs,
+                )
+                stem = jax.tree_util.tree_map(
+                    _gather_leaf, params["stem"], pspecs["stem"]
+                )
+                head = jax.tree_util.tree_map(
+                    _gather_leaf, params["head"], pspecs["head"]
+                )
+            return {"stem": stem, "chunks": chunks, "head": head}
+
+        def finish_grads(g_mat, n_virtual, n_global):
+            """Shared gradient post-processing for EVERY schedule:
+            scatter the per-chunk block grads back into the full
+            stacked form (zeros off-chunk — exactly the transpose of
+            the chunk slice), ONE fused psum over ('stage', 'data',
+            'seq') on {stem, stacked blocks, head} (scope
+            `plan_grad`), the dense mean-loss normalization, then
+            unstack to the canonical per-block tree (and the fsdp
+            1/dp slice)."""
+            n_chunk_layers = cfg.num_layers // (S * n_virtual)
+            s_idx = lax.axis_index("stage")
+
+            def scatter(leaf):
+                full = jnp.zeros(
+                    (cfg.num_layers,) + leaf.shape[2:], leaf.dtype
+                )
+                for v in range(n_virtual):
+                    full = lax.dynamic_update_slice_in_dim(
+                        full, leaf[v],
+                        (v * S + s_idx) * n_chunk_layers, axis=0,
+                    )
+                return full
+
+            g = {
+                "stem": g_mat["stem"],
+                "blocks": jax.tree_util.tree_map(
+                    scatter, g_mat["chunks"]
+                ),
+                "head": g_mat["head"],
+            }
+            with jax.named_scope(GRAD_SCOPE):
+                g = jax.tree_util.tree_map(
+                    lambda x: lax.psum(x, reduce_axes), g
+                )
+            g = jax.tree_util.tree_map(
+                lambda x: x / jnp.maximum(n_global, 1.0), g
+            )
+            grads = {
+                "stem": g["stem"],
+                "blocks": {
+                    str(j): jax.tree_util.tree_map(
+                        lambda x: x[j], g["blocks"]
+                    )
+                    for j in range(cfg.num_layers)
+                },
+                "head": g["head"],
+            }
+            return slice_grads(grads)
+
+        def run_ticks(mat, ids, targets, step, train):
             """The gpipe fill-drain tick program on ONE device
             (`pipeline_forward`'s discipline composed with the SP
             per-shard math), as a UNIFORM per-device program: every
@@ -473,18 +695,13 @@ class ComposedPlanEngine:
                 ),
                 lax.axis_index("seq"),
             )
-            # This stage's uniform Lps-block slice of the stacked
-            # block params; grads scatter back through the slice to
-            # exactly these blocks (zeros elsewhere), so the fused
+            # This stage's uniform Lps-block slice, already cut (and
+            # for fsdp, gathered per-block) by gather_stage_mat's
+            # n_virtual=1 layout; finish_grads scatters grads back to
+            # exactly these rows (zeros elsewhere), so the fused
             # stage-psum reassembles the dense gradient.
-            stacked = stack_block_params(
-                params["blocks"], cfg.num_layers
-            )
             my_blocks = jax.tree_util.tree_map(
-                lambda x: lax.dynamic_slice_in_dim(
-                    x, s_idx * Lps, Lps, axis=0
-                ),
-                stacked,
+                lambda x: x[0], mat["chunks"]
             )
             blk_ids = s_idx * Lps + jnp.arange(Lps)
 
@@ -534,10 +751,10 @@ class ComposedPlanEngine:
                 # 0 keeps its result. Position slice is seq-shard
                 # aware, like the SP engines.
                 pos = lax.dynamic_slice_in_dim(
-                    params["stem"]["position"], q_idx * tl, tl, axis=0
+                    mat["stem"]["position"], q_idx * tl, tl, axis=0
                 )
                 h0, mask0 = lm_stem_apply(
-                    params["stem"], ids_mb, cfg, drop, ctx.child(0),
+                    mat["stem"], ids_mb, cfg, drop, ctx.child(0),
                     positions=pos,
                 )
                 h_in, mask_in = unpack(buf)
@@ -558,7 +775,7 @@ class ComposedPlanEngine:
                 )
                 # Head on EVERY device; only the last stage's logits
                 # reach the loss/wire.
-                logits = lm_head_apply(params["head"], h)
+                logits = lm_head_apply(mat["head"], h)
                 y_pad = jnp.where(
                     is_last, pack_logits(logits), pack_pair(h, mask)
                 )
@@ -588,29 +805,287 @@ class ComposedPlanEngine:
             )
             return m_acc
 
+        sched = self._sched
+
+        def sched_ticks(mat, ids, targets, step):
+            """The table-driven scheduled tick program (1F1B when
+            V == 1, Megatron interleaved when V > 1) — the composed
+            counterpart of `pipeline.pipeline_ticks`, kept UNIFORM
+            across stages: every tick every device runs the full
+            chunk program (stem + its chunk's block scan + head)
+            under `jax.vjp` with where-masked seeds — the backward
+            seed is the delivered cotangent (or the loss gradient on
+            the last logical chunk) on backward ticks, zero
+            otherwise, so forward/idle ticks contribute exactly-zero
+            gradients (vjp is linear in the seed). `lax.cond` over
+            the work kind is NOT allowed here, unlike the single-axis
+            engine: at sp > 1 the 'seq' ring collectives live inside
+            the chunk apply, and a collective inside a branch only
+            some devices execute deadlocks the SPMD rendezvous —
+            uniformity costs ~2x masked chunk compute per tick and
+            buys composability with the seq axis. Two `plan_wire`
+            ppermutes per tick (activations up, cotangents down;
+            chains under 1F1B, rings under interleaving — the wrap
+            edge carries chunk-boundary hops). Forward ticks stash
+            the chunk's input window in a per-chunk ring buffer (V*R
+            rows — the O(S) activation bound, independent of M);
+            backward ticks re-read the slot and recompute under the
+            same (logical chunk, microbatch) dropout key. Returns
+            (local metric sums, unnormalized mat-space grads) — the
+            same contract `finish_grads` consumes on the gpipe
+            path."""
+            bl, tl = ids.shape
+            if bl % M:
+                raise ValueError(
+                    f"local batch {bl} not divisible by "
+                    f"num_microbatches {M}"
+                )
+            mb = bl // M
+            h_elems = mb * tl * D
+            wire_elems = h_elems + mb * tl  # (h, mask) pair
+            buf_size = max(wire_elems, mb * tl * V)
+            T, R, Rc = (
+                sched.num_ticks, sched.stash_depth, sched.cot_depth
+            )
+            # Trace-time record for the structural memory tests: the
+            # activation stash traced into this step is (V*R, buf).
+            self._last_sched_trace = {
+                "num_ticks": T, "stash_depth": R, "cot_depth": Rc,
+                "buf_size": buf_size, "num_virtual": Vs,
+            }
+            work_tab = jnp.asarray(sched.work)
+            micro_tab = jnp.asarray(sched.micro)
+            chunk_tab = jnp.asarray(sched.chunk)
+            recv_f = jnp.asarray(sched.recv_fwd)
+            recv_f_m = jnp.asarray(sched.recv_fwd_m)
+            recv_f_c = jnp.asarray(sched.recv_fwd_c)
+            recv_b = jnp.asarray(sched.recv_bwd)
+            recv_b_m = jnp.asarray(sched.recv_bwd_m)
+            recv_b_c = jnp.asarray(sched.recv_bwd_c)
+            s_idx = lax.axis_index("stage")
+            q_idx = lax.axis_index("seq")
+            ids_mbs = ids.reshape(M, mb, tl)
+            tg_mbs = targets.reshape(M, mb, tl)
+            rng_base = jax.random.fold_in(
+                jax.random.fold_in(
+                    jax.random.fold_in(jax.random.PRNGKey(0), step),
+                    lax.axis_index("data"),
+                ),
+                lax.axis_index("seq"),
+            )
+            Lpc = cfg.num_layers // C  # blocks per logical chunk
+
+            def pack(flat):
+                pad = buf_size - flat.shape[0]
+                return jnp.pad(flat, (0, pad)) if pad else flat
+
+            def pack_pair(h, mask):
+                return pack(jnp.concatenate([
+                    h.astype(wire_dt).reshape(-1),
+                    mask.astype(wire_dt).reshape(-1),
+                ]))
+
+            def pack_logits(logits):
+                return pack(logits.astype(wire_dt).reshape(-1))
+
+            def unpack(buf):
+                h = buf[:h_elems].reshape(mb, tl, D)
+                mask = buf[h_elems:wire_elems].reshape(mb, tl) > 0.5
+                return h, mask
+
+            zeros_m = {
+                k: jnp.float32(0.0)
+                for k in ("loss_sum", "correct1", "correct5", "count")
+            }
+            zeros_buf = jnp.zeros((buf_size,), wire_dt)
+            if sched.num_virtual == 1:
+                up_pairs = [(i, i + 1) for i in range(S - 1)]
+                down_pairs = [(i + 1, i) for i in range(S - 1)]
+            else:
+                # Ring wires: the wrap edge is the chunk-boundary hop
+                # (logical v*S+S-1 -> (v+1)*S crosses device S-1 ->
+                # device 0).
+                up_pairs = [(i, (i + 1) % S) for i in range(S)]
+                down_pairs = [((i + 1) % S, i) for i in range(S)]
+
+            def tick(carry, t):
+                up_buf, down_buf, stash, cots, m_acc, g_acc = carry
+                w = work_tab[t, s_idx]
+                m = micro_tab[t, s_idx]
+                v = chunk_tab[t, s_idx]
+                # Receive-before-compute: the wire buffers hold tick
+                # t-1's permute output; the static tables say whether
+                # that payload is real and which (chunk, microbatch)
+                # ring slot it belongs in.
+                slot = recv_f_c[t, s_idx] * R + recv_f_m[t, s_idx] % R
+                stash = lax.dynamic_update_index_in_dim(
+                    stash,
+                    jnp.where(
+                        recv_f[t, s_idx], up_buf,
+                        lax.dynamic_index_in_dim(stash, slot, 0, False),
+                    ),
+                    slot, 0,
+                )
+                cslot = (
+                    recv_b_c[t, s_idx] * Rc + recv_b_m[t, s_idx] % Rc
+                )
+                cots = lax.dynamic_update_index_in_dim(
+                    cots,
+                    jnp.where(
+                        recv_b[t, s_idx], down_buf,
+                        lax.dynamic_index_in_dim(cots, cslot, 0, False),
+                    ),
+                    cslot, 0,
+                )
+                l = v * S + s_idx  # logical chunk index
+                is_first_l = l == 0
+                is_last_l = l == C - 1
+                valid = w != PIPE_IDLE
+                ids_mb = lax.dynamic_index_in_dim(
+                    ids_mbs, m, keepdims=False
+                )
+                tg_mb = lax.dynamic_index_in_dim(
+                    tg_mbs, m, keepdims=False
+                )
+                # Per-(logical chunk, microbatch) dropout key —
+                # identical at the forward tick and its backward-tick
+                # recompute (and == the gpipe key when V == 1).
+                rng = jax.random.fold_in(
+                    jax.random.fold_in(rng_base, l), m
+                )
+                ctx = L.Context(
+                    train=True, rng=rng, dtype=cdt, matmul=mm
+                )
+                x_in = lax.dynamic_index_in_dim(
+                    stash, v * R + m % R, 0, False
+                )
+
+                def f(mat_, x_buf):
+                    pos = lax.dynamic_slice_in_dim(
+                        mat_["stem"]["position"], q_idx * tl, tl,
+                        axis=0,
+                    )
+                    h0, mask0 = lm_stem_apply(
+                        mat_["stem"], ids_mb, cfg, drop, ctx.child(0),
+                        positions=pos,
+                    )
+                    h_in, mask_in = unpack(x_buf)
+                    h = jnp.where(
+                        is_first_l, h0.astype(h_in.dtype), h_in
+                    )
+                    # Idle ticks carry an all-False wire mask; fall
+                    # back to the (benign) stem mask there so
+                    # attention never sees a fully-masked row.
+                    mask = jnp.where(
+                        is_first_l | ~valid, mask0, mask_in
+                    )
+                    cp = jax.tree_util.tree_map(
+                        lambda a: lax.dynamic_index_in_dim(
+                            a, v, 0, False
+                        ),
+                        mat_["chunks"],
+                    )
+                    blk_ids = l * Lpc + jnp.arange(Lpc)
+                    block_ctx = ctx.child(1)
+
+                    def blk(x, sl):
+                        pb, j = sl
+                        y, _ = block_apply(
+                            pb, {}, x, block_ctx.child(j)
+                        )
+                        return y, None
+
+                    (h, mask), _ = lax.scan(
+                        blk, (h, mask), (cp, blk_ids)
+                    )
+                    logits = lm_head_apply(mat_["head"], h)
+                    y_pad = jnp.where(
+                        is_last_l, pack_logits(logits),
+                        pack_pair(h, mask),
+                    )
+                    y_pad = jnp.where(
+                        valid, y_pad, jnp.zeros_like(y_pad)
+                    )
+                    m_tick = _local_sums(
+                        logits.astype(jnp.float32), tg_mb
+                    )
+                    return (y_pad, m_tick["loss_sum"]), m_tick
+
+                is_bwd = w == PIPE_BWD
+                (y_pad, _), vjp_fn, m_tick = jax.vjp(
+                    f, mat, x_in, has_aux=True
+                )
+                # Seeds: the delivered cotangent on middle-chunk
+                # backward ticks, d(loss_sum)=1 on last-chunk
+                # backward ticks, zero everywhere else — so the vjp
+                # of a forward/idle tick is exactly zero and the
+                # unconditional accumulate below is exact.
+                y_bar = jnp.where(
+                    is_bwd & ~is_last_l,
+                    lax.dynamic_index_in_dim(
+                        cots, v * Rc + m % Rc, 0, False
+                    ),
+                    zeros_buf,
+                )
+                loss_bar = jnp.where(
+                    is_bwd & is_last_l,
+                    jnp.float32(1.0), jnp.float32(0.0),
+                )
+                g_mat_t, g_x = vjp_fn((y_bar, loss_bar))
+                g_acc = jax.tree_util.tree_map(
+                    jnp.add, g_acc, g_mat_t
+                )
+                # Metrics count each microbatch ONCE: at its
+                # last-logical-chunk forward tick (the gpipe loop's
+                # valid & is_last weight, table-driven).
+                w_m = (
+                    (w == PIPE_FWD) & is_last_l
+                ).astype(jnp.float32)
+                m_acc = {
+                    k: m_acc[k] + m_tick[k] * w_m for k in m_acc
+                }
+                up = jnp.where(w == PIPE_FWD, y_pad, zeros_buf)
+                down = jnp.where(is_bwd, g_x, zeros_buf)
+                with jax.named_scope(WIRE_SCOPE):
+                    up_buf = lax.ppermute(up, "stage", up_pairs)
+                    down_buf = lax.ppermute(
+                        down, "stage", down_pairs
+                    )
+                return (
+                    up_buf, down_buf, stash, cots, m_acc, g_acc
+                ), None
+
+            g0 = jax.tree_util.tree_map(jnp.zeros_like, mat)
+            carry0 = (
+                zeros_buf, zeros_buf,
+                jnp.zeros((Vs * R, buf_size), wire_dt),
+                jnp.zeros((Vs * Rc, buf_size), wire_dt),
+                zeros_m, g0,
+            )
+            (_, _, _, _, m_acc, g_acc), _ = lax.scan(
+                tick, carry0, jnp.arange(T)
+            )
+            return m_acc, g_acc
+
         def shard_step(ts: TrainState, ids, targets, lr):
-            full_params = gather_params(ts.params)
+            mat = gather_stage_mat(ts.params, Vs)
+            if sched is None:
+                def loss_fn(mat_):
+                    m = run_ticks(mat_, ids, targets, ts.step, True)
+                    # LOCAL token-loss sum (pipeline discipline).
+                    return m["loss_sum"], m
 
-            def loss_fn(params):
-                m = run_ticks(params, ids, targets, ts.step, True)
-                # LOCAL token-loss sum (pipeline discipline).
-                return m["loss_sum"], m
-
-            (_, m), grads = jax.value_and_grad(
-                loss_fn, has_aux=True
-            )(full_params)
+                (_, m), g_mat = jax.value_and_grad(
+                    loss_fn, has_aux=True
+                )(mat)
+            else:
+                m, g_mat = sched_ticks(mat, ids, targets, ts.step)
             n_global = lax.psum(m["count"], reduce_axes)
             # Complementary pieces on every axis: zero off-stage,
             # partial per 'seq' shard, per-replica sums over 'data' —
-            # ONE fused psum, then the dense mean-loss normalization.
-            with jax.named_scope(GRAD_SCOPE):
-                grads = jax.tree_util.tree_map(
-                    lambda g: lax.psum(g, reduce_axes), grads
-                )
-            grads = jax.tree_util.tree_map(
-                lambda g: g / jnp.maximum(n_global, 1.0),
-                slice_grads(grads),
-            )
+            # ONE fused psum, then the dense mean-loss normalization
+            # (both inside finish_grads).
+            grads = finish_grads(g_mat, Vs, n_global)
             params, opt_state = self.optimizer.update(
                 ts.params, ts.opt_state, grads, lr
             )
@@ -622,8 +1097,13 @@ class ComposedPlanEngine:
             }
 
         def shard_eval(ts: TrainState, ids, targets):
+            # Eval ALWAYS runs the gpipe forward program over the
+            # n_virtual=1 stage layout: the schedule only reorders
+            # the train-time backward, so there is nothing for eval
+            # to schedule (schedule is execution-only).
             m = run_ticks(
-                gather_params(ts.params), ids, targets, ts.step, False
+                gather_stage_mat(ts.params, 1), ids, targets,
+                ts.step, False,
             )
             return {k: lax.psum(v, reduce_axes) for k, v in m.items()}
 
@@ -725,6 +1205,7 @@ def build_plan_engine(
     remat: bool = False,
     donate: bool = True,
     force_composed: bool = False,
+    min_shard_elems: int = 1024,
 ):
     """The one engine entry point: a GPT(-MoE) config plus a
     ParallelPlan (or its spec string) returns the engine that runs it —
@@ -752,11 +1233,18 @@ def build_plan_engine(
     moe = getattr(cfg, "num_experts", 0) > 0
     if plan.ep > 1 or (moe and not force_composed):
         if plan.pp > 1 or plan.tp_or_sp > 1 or plan.fsdp:
+            offending = ", ".join(
+                f"{name}={v}" for name, v in (
+                    ("pp", plan.pp), ("tp_or_sp", plan.tp_or_sp),
+                    ("fsdp", plan.fsdp),
+                ) if v not in (1, False)
+            )
             raise NotImplementedError(
-                f"plan {plan.spec!r}: the expert axis composes with dp "
-                "only (experts ride the data fabric through "
-                "ExpertParallelLMEngine); pp/sp/fsdp x ep plans are "
-                "not built — see ROADMAP item 1"
+                f"plan {plan.spec!r}: ParallelPlan.ep={plan.ep} "
+                "composes with the dp field only (experts ride the "
+                "data fabric through ExpertParallelLMEngine), but "
+                f"this --plan also sets {offending} — drop those "
+                "tokens from --plan, or drop its ep token"
             )
         if not moe:
             raise ValueError(
@@ -795,11 +1283,20 @@ def build_plan_engine(
         mesh = make_mesh(
             MeshSpec(data=plan.dp, stage=plan.pp), devices=devices[:n]
         )
+        # The schedule degenerates with the plan: a pp-only scheduled
+        # plan IS the single-axis engine's 1f1b / interleaved program
+        # (interleaving splits the model into pp*V round-robin
+        # chunks).
         return LMPipelineEngine(
-            split_stages(plan.pp, cfg), optimizer, mesh,
-            num_microbatches=num_microbatches or plan.pp,
+            split_stages(plan.pp * plan.virtual_stages, cfg),
+            optimizer, mesh,
+            num_microbatches=num_microbatches or (
+                plan.pp * plan.virtual_stages
+                if plan.schedule == "interleaved" else plan.pp
+            ),
             donate=donate, compute_dtype=compute_dtype, remat=remat,
-            pad_token_id=cfg.pad_token_id,
+            pad_token_id=cfg.pad_token_id, schedule=plan.schedule,
+            virtual_stages=plan.virtual_stages,
         )
     if not composed and plan.tp_or_sp > 1:
         from distributed_model_parallel_tpu.parallel.sequence_parallel import (
@@ -828,11 +1325,13 @@ def build_plan_engine(
         num_microbatches=num_microbatches, attention=attention,
         donate=donate, compute_dtype=compute_dtype, remat=remat,
         collective_matmul=collective_matmul,
+        min_shard_elems=min_shard_elems,
     )
 
 
 __all__ = [
     "ComposedPlanEngine",
+    "PLAN_SCHEDULES",
     "ParallelPlan",
     "build_plan_engine",
     "parse_plan",

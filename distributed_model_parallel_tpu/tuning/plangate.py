@@ -59,7 +59,10 @@ def grid() -> List[Cell]:
     hierarchical-MoE fabric at two scales, the tp ring cell, the
     paged-serving cell (page_size x prefill_chunk, ISSUE 15), and the
     composed-plan factorization cell over the full 8-device CI mesh
-    (ISSUE 19: the argmin is a whole ParallelPlan spec)."""
+    (ISSUE 19: the argmin is a whole ParallelPlan spec), plus the
+    SCHEDULED plan cell (ISSUE 20, model tag "sched"): the pp2
+    gpipe/1f1b/int2 twins at M just above pp, pinning that the tuner
+    prices and selects a scheduled plan that beats its gpipe twin."""
     return [
         Cell("ddp", 4, 2, "mlp"),
         Cell("ddp", 8, 2, "tinycnn"),
@@ -72,6 +75,7 @@ def grid() -> List[Cell]:
         Cell("tp", 4),
         Cell("serve", 2),
         Cell("plan", 8),
+        Cell("plan", 8, model="sched"),
     ]
 
 

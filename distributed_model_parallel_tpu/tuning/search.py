@@ -112,6 +112,10 @@ def cell_payload(cell: Cell) -> dict:
             "dim": cfg.dim,
             "vocab": cfg.vocab_size,
             "n_layers": cfg.num_layers,
+            # For the compute/bubble fold (ISSUE 20): the proxy's f32
+            # parameter count — what the schedule knob's smaller
+            # bubble actually multiplies.
+            "params": grad_bytes // 4,
         }
     if cell.family in ("ddp", "fsdp"):
         if cell.model == "tinycnn":
@@ -267,19 +271,36 @@ def serve_closed_form_s(knobs: dict, payload: dict,
 def plan_closed_form_s(knobs: dict, payload: dict, ici: int, dcn: int,
                        constants: Optional[Dict[str, float]] = None,
                        ) -> float:
-    """Predicted step time for one composed-plan candidate (ISSUE 19):
-    `cost.composed_plan_step_s` over the spec's axis factorization —
-    the gpipe wire leg on its fabric, the ring-attention KV hops on
-    ICI, the ONE fused gradient psum as the hierarchical two-level
-    form at dcn > 1."""
+    """Predicted step time for one composed-plan candidate (ISSUE
+    19/20): `cost.composed_plan_step_s` over the spec's axis
+    factorization — the schedule's wire tick program on its fabric,
+    the ring-attention KV hops on ICI, the ONE fused gradient psum as
+    the hierarchical two-level form at dcn > 1, plus the proxy's ideal
+    compute under the schedule's bubble factor (the term the
+    1f1b/int<V> suffixes and the num_microbatches knob trade against
+    the extra wire ticks)."""
     from distributed_model_parallel_tpu.observability import cost
 
     ax = tspace.plan_spec_axes(knobs["plan"])
+    m = knobs.get("num_microbatches") or 0
+    mb = payload["mb"]
+    if m:
+        # The proxy batch is fixed (mb rows per default microbatch, M
+        # = pp of them); a deeper fill splits the same rows thinner.
+        mb = max(1, payload["mb"] * ax["pp"] // m)
+    compute_s = cost.plan_step_compute_s(
+        payload["params"],
+        payload["mb"] * ax["dp"] * ax["pp"] * payload["seq_len"],
+        ax["pp"] * ax["sp"] * ax["dp"],
+        constants=constants,
+    ) if "params" in payload else 0.0
     return cost.composed_plan_step_s(
         ax["pp"], ax["sp"], ax["dp"],
-        payload["grad_bytes"], payload["mb"], payload["seq_len"],
+        payload["grad_bytes"], mb, payload["seq_len"],
         payload["dim"], payload["vocab"], payload["n_layers"],
         ici, dcn, fsdp=ax["fsdp"], constants=constants,
+        schedule=ax["schedule"], virtual_stages=ax["virtual"],
+        num_microbatches=m, compute_s=compute_s,
     )
 
 
@@ -367,7 +388,10 @@ def candidate_combo(cell: Cell, knobs: dict):
             collective_matmul=knobs["collective_matmul"],
         )
     if cell.family == "plan":
-        return Combo("plan", cell.size, plan=knobs["plan"])
+        return Combo(
+            "plan", cell.size, plan=knobs["plan"],
+            num_microbatches=knobs.get("num_microbatches") or 0,
+        )
     if cell.family == "serve":
         # The paged decode step lowers per page_size; prefill_chunk
         # shapes the HOST loop only (no compiled-step difference), so
@@ -440,11 +464,19 @@ def search_cell(cell: Cell,
     from distributed_model_parallel_tpu.tuning.plan import validate_plan
 
     say = emit if emit is not None else (lambda s: None)
-    cands = list(
-        space_knobs if space_knobs is not None
-        else tspace.candidates(cell.family, cell.dcn,
-                               allow_cm=allow_cm, size=cell.size)
-    )
+    if space_knobs is not None:
+        cands = list(space_knobs)
+    elif cell.family == "plan" and cell.model == "sched":
+        # The scheduled cell (ISSUE 20) is a SCOPED comparison, not
+        # the full factorization space: gpipe vs 1f1b vs int2 at fixed
+        # pp x M, so the committed argmin pins the schedule tradeoff
+        # itself (plan/S8 already pins the factorization).
+        cands = tspace.scheduled_plan_candidates(cell.size)
+    else:
+        cands = list(
+            tspace.candidates(cell.family, cell.dcn,
+                              allow_cm=allow_cm, size=cell.size)
+        )
     if not cands:
         raise ValueError(f"{cell.name}: empty candidate space")
     ici = cell.size // cell.dcn
@@ -471,6 +503,15 @@ def search_cell(cell: Cell,
                 import add_serve_compute
 
             row = add_serve_compute(row, combo)
+        elif cell.family == "plan":
+            # The plan twin (ISSUE 20): lowered comm is schedule-
+            # symmetric by construction, so the bubble-stretched
+            # compute term is what decides the sched cell's argmin —
+            # same fold as the costgate ledger (`add_plan_compute`).
+            from distributed_model_parallel_tpu.observability.cost \
+                import add_plan_compute
+
+            row = add_plan_compute(row, combo, constants)
         say(f"[tuning]   {combo.name}: closed-form "
             f"{closed_s * 1e3:.4f} ms -> lowered "
             f"{row['predicted_step_s'] * 1e3:.4f} ms/step")

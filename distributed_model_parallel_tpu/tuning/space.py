@@ -75,7 +75,9 @@ _CM_KNOB = Knob("collective_matmul", (False, True),
 # tests/test_tuning.py (`plan_spec_axes` vs `parse_plan` on the whole
 # grid).
 
-_PLAN_TOKEN_RE = re.compile(r"^(pp|sp|tp|dp|fsdp|ep)(\d+)$")
+_PLAN_TOKEN_RE = re.compile(
+    r"^(pp|sp|tp|dp|fsdp|ep)(\d+)(?:-(1f1b|int(\d+)))?$"
+)
 
 _PLAN_TOKEN_AXIS = {
     "pp": "pp", "sp": "sp", "tp": "sp", "dp": "dp", "fsdp": "dp",
@@ -85,17 +87,22 @@ _PLAN_TOKEN_AXIS = {
 
 def plan_spec_axes(spec: str) -> dict:
     """jax-free parse of a plan spec string into
-    {"pp", "sp", "dp", "ep", "fsdp"} — the same grammar as
-    `parallel.plan.parse_plan` (tokens `(pp|sp|tp|dp|fsdp|ep)<n>`
-    joined by 'x', duplicate axes rejected)."""
-    axes = {"pp": 1, "sp": 1, "dp": 1, "ep": 1, "fsdp": False}
+    {"pp", "sp", "dp", "ep", "fsdp", "schedule", "virtual"} — the same
+    grammar as `parallel.plan.parse_plan` (tokens
+    `(pp|sp|tp|dp|fsdp|ep)<n>` joined by 'x', duplicate axes rejected;
+    ISSUE 20's `-1f1b` / `-int<V>` schedule suffix rides the pp token
+    only)."""
+    axes = {"pp": 1, "sp": 1, "dp": 1, "ep": 1, "fsdp": False,
+            "schedule": "gpipe", "virtual": 1}
     seen = set()
     for tok in spec.split("x"):
+        tok = tok.strip().rstrip("-")
         m = _PLAN_TOKEN_RE.match(tok)
         if not m:
             raise ValueError(
                 f"bad plan token {tok!r} in {spec!r} (want "
-                "(pp|sp|tp|dp|fsdp|ep)<n> joined by 'x')"
+                "(pp|sp|tp|dp|fsdp|ep)<n>[-1f1b|-int<V>] joined by "
+                "'x')"
             )
         field = _PLAN_TOKEN_AXIS[m.group(1)]
         if field in seen:
@@ -104,16 +111,38 @@ def plan_spec_axes(spec: str) -> dict:
         axes[field] = int(m.group(2))
         if m.group(1) == "fsdp":
             axes["fsdp"] = True
+        if m.group(3):
+            if m.group(1) != "pp":
+                raise ValueError(
+                    f"schedule suffix on non-pp token {tok!r} in "
+                    f"{spec!r} (ParallelPlan.schedule rides the pp "
+                    "token)"
+                )
+            if m.group(3) == "1f1b":
+                axes["schedule"] = "1f1b"
+            else:
+                v = int(m.group(4))
+                if v < 2:
+                    raise ValueError(
+                        f"int{v} in {spec!r}: V=1 interleaving IS "
+                        "1f1b — spell it pp<S>-1f1b"
+                    )
+                axes["schedule"] = "interleaved"
+                axes["virtual"] = v
     return axes
 
 
-def _plan_spec(pp: int, sp: int, dp: int, fsdp: bool) -> str:
+def _plan_spec(pp: int, sp: int, dp: int, fsdp: bool,
+               schedule: str = "gpipe", virtual: int = 1) -> str:
     """Spec-string builder matching `ParallelPlan.spec` byte-for-byte:
     only non-1 axes are emitted, in order pp, sp, dp-or-fsdp (the dp
-    bit also appears when it is the ONLY axis)."""
+    bit also appears when it is the ONLY axis); the schedule suffix
+    rides the pp bit."""
     bits = []
     if pp > 1:
-        bits.append(f"pp{pp}")
+        sched = {"gpipe": "", "1f1b": "-1f1b",
+                 "interleaved": f"-int{virtual}"}[schedule]
+        bits.append(f"pp{pp}{sched}")
     if sp > 1:
         bits.append(f"sp{sp}")
     if dp > 1 or not bits:
@@ -123,9 +152,12 @@ def _plan_spec(pp: int, sp: int, dp: int, fsdp: bool) -> str:
 
 def plan_specs(total: int) -> tuple:
     """All power-of-2 (pp, sp, dp) factorizations of `total` devices,
-    each dp>1 point twinned with its fsdp variant. Deterministic order
-    (pp outer, sp inner, dense before fsdp) — the enumeration order is
-    part of the byte-stability contract."""
+    each dp>1 point twinned with its fsdp variant, and each pp>1 point
+    twinned with its 1f1b and int2 scheduled variants (ISSUE 20 — the
+    gpipe plan stays a point in the scheduled space). Deterministic
+    order (pp outer, sp inner, dense before fsdp, gpipe before 1f1b
+    before int2) — the enumeration order is part of the byte-stability
+    contract."""
     sizes = []
     w = 1
     while w <= total:
@@ -139,9 +171,13 @@ def plan_specs(total: int) -> tuple:
             if total % (pp * sp):
                 continue
             dp = total // (pp * sp)
-            out.append(_plan_spec(pp, sp, dp, False))
-            if dp > 1:
-                out.append(_plan_spec(pp, sp, dp, True))
+            schedules = [("gpipe", 1)]
+            if pp > 1:
+                schedules += [("1f1b", 1), ("interleaved", 2)]
+            for sched, v in schedules:
+                out.append(_plan_spec(pp, sp, dp, False, sched, v))
+                if dp > 1:
+                    out.append(_plan_spec(pp, sp, dp, True, sched, v))
     return tuple(out)
 
 
@@ -149,6 +185,27 @@ def plan_specs(total: int) -> tuple:
 # 8-device CI mesh (plangate's plan/S8 cell) and the 64-way scaling
 # study (experiments/scaling64.py §3f).
 _PLAN_GRID = plan_specs(8) + plan_specs(64)
+
+
+def scheduled_plan_candidates(total: int) -> List[dict]:
+    """The plangate sched cell's scoped space (plan/S<n>/sched,
+    ISSUE 20): the pp2 gpipe / 1f1b / int2 twins at num_microbatches=4
+    — M just above pp, the first point where a scheduled plan's
+    smaller bubble can beat its gpipe twin's shorter tick program.
+    All three are lowered (3 <= DEFAULT_FINALISTS), so the pinned
+    argmin is decided at the lowering tier, not the closed form."""
+    if total % 2:
+        raise ValueError(
+            f"sched cell wants an even device count, got {total}"
+        )
+    dp = total // 2
+    return [
+        {"plan": _plan_spec(2, 1, dp, False, sched, v),
+         "num_microbatches": 4}
+        for sched, v in (
+            ("gpipe", 1), ("1f1b", 1), ("interleaved", 2),
+        )
+    ]
 
 SPACES: Dict[str, Tuple[Knob, ...]] = {
     "ddp": _REDUCER_KNOBS,
@@ -192,11 +249,20 @@ SPACES: Dict[str, Tuple[Knob, ...]] = {
              "speculative_k"),
     ),
     # Composed mesh-axis plans (ISSUE 19): one spec-string knob whose
-    # grid IS the factorization space. The engine field is
-    # `ComposedPlanEngine.plan`; the CLI flag is the training CLIs'
-    # `--plan`. Candidate filtering (device count, DCN slice
-    # boundaries) happens in `_canonicalize` against the cell's mesh.
-    "plan": (Knob("plan", _PLAN_GRID, "--plan", "plan"),),
+    # grid IS the factorization space — including the ISSUE 20
+    # schedule suffixes (pp<S>-1f1b / pp<S>-int<V>), so the tuner
+    # trades bubble against wire hops inside ONE family. The engine
+    # field is `ComposedPlanEngine.plan`; the CLI flag is the training
+    # CLIs' `--plan`. num_microbatches sizes the pipeline fill (0 =
+    # the engine default M = pp*V); M just above pp is where a
+    # scheduled plan first beats its gpipe twin. Candidate filtering
+    # (device count, DCN slice boundaries, M bounds) happens in
+    # `_canonicalize` against the cell's mesh.
+    "plan": (
+        Knob("plan", _PLAN_GRID, "--plan", "plan"),
+        Knob("num_microbatches", (0, 4), "--microbatches",
+             "num_microbatches"),
+    ),
 }
 
 
@@ -228,6 +294,17 @@ def _canonicalize(family: str, knobs: dict, dcn: int,
                 return None
             if ax["sp"] > ndev // dcn:
                 return None  # a ring-attention hop would cross DCN
+        m = k.get("num_microbatches") or 0
+        if ax["pp"] == 1 or not m:
+            # No pipeline to fill (or the engine default M = pp*V):
+            # not a knob — collapse so equivalent configs dedupe.
+            k["num_microbatches"] = None
+        else:
+            # The engine's own fail-fast guards: M >= pp*V fills every
+            # (virtual) stage; the interleaved builder round-robins
+            # microbatch groups of S (M % pp == 0).
+            if m < ax["pp"] * ax["virtual"] or m % ax["pp"]:
+                return None
         return k
     if family in ("ddp", "fsdp", "sp_lm"):
         if k["dcn_compression"] != "none" and dcn < 2:
@@ -295,9 +372,18 @@ def preference(family: str, knobs: dict) -> tuple:
         # Equal-cost ties break toward the LEAST-restructured plan:
         # fewer pipeline stages, then fewer sequence shards, then dense
         # dp over fsdp (resharding machinery the cost model doesn't pay
-        # for is free complexity).
+        # for is free complexity), then the SIMPLER schedule (gpipe
+        # before 1f1b before interleaved — a tick table the bubble
+        # term doesn't pay for is free machinery), then fewer
+        # microbatches (a deeper fill the bubble doesn't pay for is
+        # free latency).
         ax = plan_spec_axes(knobs["plan"])
-        return (ax["pp"], ax["sp"], int(ax["fsdp"]))
+        return (
+            ax["pp"], ax["sp"], int(ax["fsdp"]),
+            {"gpipe": 0, "1f1b": 1, "interleaved": 2}[ax["schedule"]],
+            ax["virtual"],
+            knobs.get("num_microbatches") or 0,
+        )
     # tp: prefer the ring decomposition on a tie (latency hiding).
     return (0 if knobs["collective_matmul"] else 1,)
 
@@ -392,4 +478,5 @@ __all__ = [
     "plan_specs",
     "preference",
     "scan_knob_surface",
+    "scheduled_plan_candidates",
 ]

@@ -565,6 +565,38 @@ def main():
           f"{plan_argmin_s*1e3:.2f} ms (best single-axis: "
           f"{min(plan_single_axis.values())*1e3:.2f} ms; composed "
           f"pp2xsp2xdp16: {plan_anatomy['pp2xsp2xdp16']*1e3:.2f} ms)")
+    # Scheduled-plan rows (ISSUE 20): the gpipe/1f1b/int2 twins of
+    # ONE pp2 factorization at fixed M=4, priced with the compute x
+    # bubble fold ('params' in the payload turns it on) ON TOP of the
+    # asked-bytes wire terms. The schedule changes only the tick
+    # program, so the twins share layouts and collectives; the rows
+    # record what each schedule's bubble costs (gpipe/1f1b (M+pp-1)/M,
+    # interleaved (VM+pp-1)/VM) against its extra wire ticks. The
+    # gpipe plan is a POINT in the scheduled space, so the argmin
+    # over the grown space can never predict worse than it — the
+    # never-worse-than-gpipe assertion, like §3e's.
+    plan_sched_payload = dict(plan_payload, params=plan_grad_bytes // 4)
+    plan_sched = {}
+    for spec in ("pp2xdp32", "pp2-1f1bxdp32", "pp2-int2xdp32"):
+        plan_sched[spec] = round(closed_form_step_s(
+            "plan", {"plan": spec, "num_microbatches": 4},
+            plan_sched_payload, ici, DCN_SLICES,
+        ), 6)
+    sched_knobs, sched_argmin_s = closed_form_argmin(
+        "plan", plan_sched_payload, ici, DCN_SLICES,
+    )
+    assert sched_argmin_s <= plan_sched["pp2xdp32"] * (1 + 1e-9), (
+        f"scheduled-plan argmin {sched_argmin_s:.6e}s predicts WORSE "
+        f"than the gpipe pp2xdp32/M4 row "
+        f"{plan_sched['pp2xdp32']:.6e}s — the gpipe plan is a point "
+        "in the scheduled space, so the search is broken"
+    )
+    print(f"tuner argmin (scheduled plan @{DCN_SLICES}x{ici}, with "
+          f"compute fold): {json.dumps(sched_knobs, sort_keys=True)} "
+          f"-> {sched_argmin_s*1e3:.2f} ms (gpipe twin @M4: "
+          f"{plan_sched['pp2xdp32']*1e3:.2f} ms, 1f1b: "
+          f"{plan_sched['pp2-1f1bxdp32']*1e3:.2f} ms, int2: "
+          f"{plan_sched['pp2-int2xdp32']*1e3:.2f} ms)")
     plan_rows = {
         "payload": plan_payload,
         "argmin": {
@@ -573,6 +605,11 @@ def main():
         },
         "single_axis_s": plan_single_axis,
         "composed_anatomy_s": plan_anatomy,
+        "scheduled_twins_s": plan_sched,
+        "scheduled_argmin": {
+            "knobs": sched_knobs,
+            "predicted_s": round(sched_argmin_s, 6),
+        },
     }
 
     out = {

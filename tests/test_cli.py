@@ -1020,14 +1020,14 @@ def test_lm_cli_plan_flag_guards():
         lm.main(["--plan", "pp2xdp4", "--pipeline-stages", "2"])
     with pytest.raises(SystemExit, match="IS the mesh factorization"):
         lm.main(["--plan", "sp2xdp4", "--seq-shards", "2"])
-    with pytest.raises(SystemExit, match="gpipe tick"):
+    with pytest.raises(SystemExit, match="pp token's suffix"):
         lm.main(["--plan", "pp2xdp4",
                  "--pipeline-schedule", "interleaved"])
     with pytest.raises(SystemExit, match="has pp=1"):
         lm.main(["--plan", "dp8", "--microbatches", "4"])
     with pytest.raises(SystemExit, match="expert surface"):
         lm.main(["--plan", "ep2xdp4"])
-    with pytest.raises(SystemExit, match="ep=1"):
+    with pytest.raises(SystemExit, match=r"ParallelPlan\.ep=1"):
         lm.main(["--plan", "dp8", "--moe-experts", "8"])
     with pytest.raises(SystemExit, match="sp=1"):
         lm.main(["--plan", "pp2xdp4", "--attention", "ring_flash"])
@@ -1049,6 +1049,41 @@ def test_lm_cli_plan_flag_guards():
     # --plan is mutually exclusive with --auto-tune owning the knobs
     with pytest.raises(SystemExit, match="--plan"):
         lm.main(["--plan", "dp8", "--auto-tune", "search"])
+
+
+def test_lm_cli_scheduled_plan_guards():
+    """The scheduled --plan grammar's refusal paths (ISSUE 20), each
+    naming the offending plan FIELD and the flag that sets it: the
+    suffix rides only the pp token, V=1 interleaving is spelled 1f1b,
+    a pp=1 plan cannot be scheduled, the hand-set schedule flags stay
+    mutually exclusive with a scheduled spec, and the engine's
+    fail-fast bounds (M >= pp*V for interleaved; pp*V must divide the
+    block count) surface through the CLI with --microbatches and
+    --layers named."""
+    from distributed_model_parallel_tpu.cli import lm
+
+    with pytest.raises(SystemExit, match="schedule suffix"):
+        lm.main(["--plan", "sp2-1f1bxdp4"])  # suffix off the pp token
+    with pytest.raises(SystemExit, match="1f1b"):
+        lm.main(["--plan", "pp2-int1xdp4"])  # V=1 interleaving
+    with pytest.raises(SystemExit, match="pp token"):
+        lm.main(["--plan", "pp1-1f1bxdp8"])  # nothing to schedule
+    with pytest.raises(SystemExit, match="pp token's suffix"):
+        lm.main(["--plan", "pp2-1f1bxdp4",
+                 "--pipeline-schedule", "1f1b"])  # spec owns it
+    with pytest.raises(SystemExit, match="pp token's suffix"):
+        lm.main(["--plan", "pp2-int2xdp2", "--virtual-stages", "2"])
+    with pytest.raises(SystemExit, match="--microbatches"):
+        lm.main(["--plan", "pp2-int2xdp2", "--microbatches", "2",
+                 "--corpus-tokens", "4096"])  # M=2 < pp*V=4
+    with pytest.raises(SystemExit, match="--layers"):
+        lm.main(["--plan", "pp2-int2xdp2", "--layers", "6",
+                 "--corpus-tokens", "4096"])  # 6 blocks into 4 chunks
+    # The interleaved default M is pp*V (not pp): batch divisibility
+    # is checked against the schedule-aware microbatch count.
+    with pytest.raises(SystemExit, match="must divide"):
+        lm.main(["--plan", "pp2-int2xdp2", "-b", "12",
+                 "--corpus-tokens", "4096"])  # 12 % (4*2) != 0
 
 
 def test_lm_cli_composed_plan_e2e(tmp_path, monkeypatch):

@@ -1010,9 +1010,12 @@ def test_exemptions_report_but_do_not_count():
 
 def plan_target(**kw):
     """Canned composed-plan target: a 2x2x2 PP x SP x DP plan whose
-    traced collective inventory is exactly the contract — one
-    plan_wire ppermute on ('stage',), one kv_ring hop on ('seq',),
-    one fused plan_grad psum over all three axes."""
+    traced collective inventory is exactly the contract — TWO
+    plan_wire ppermutes on ('stage',) (the table-driven tick
+    program's static count, for every schedule: forward wire +
+    autodiff transpose under gpipe, up + down wires scheduled), one
+    kv_ring hop on ('seq',), one fused plan_grad psum over all three
+    axes."""
     base = dict(
         name="t", engine="plan",
         data_axes=("data",), ici_axis="data", ici_size=2,
@@ -1020,6 +1023,8 @@ def plan_target(**kw):
         plan_collective_records=(
             ("ppermute", ("stage",), "f32",
              "jit(f)/plan_wire/ppermute", 64),
+            ("ppermute", ("stage",), "f32",
+             "jit(f)/transpose(plan_wire)/ppermute", 64),
             ("ppermute", ("seq",), "f32",
              "jit(f)/kv_ring/ppermute", 64),
             ("psum", ("stage", "data", "seq"), "f32",
@@ -1056,6 +1061,36 @@ def test_plan_wire_stage_only_clean():
     assert check(
         "plan-wire-fabric", plan_target(), module([]), MESH8
     ) == []
+    # The scheduled twins trace the SAME static wire count — the
+    # schedule-symmetric inventory the ISSUE 20 tick tables pin.
+    for sched, v in (("1f1b", 1), ("interleaved", 2)):
+        assert check(
+            "plan-wire-fabric",
+            plan_target(plan_schedule=sched, plan_virtual=v),
+            module([]), MESH8,
+        ) == []
+
+
+@pytest.mark.hlo_rule("plan-wire-fabric", "positive")
+def test_plan_wire_count_pins_table_driven_replay():
+    # An UNROLLED per-tick program would trace O(ticks) stage
+    # ppermutes; the rule pins the per-schedule static count (2) so
+    # a replay regression cannot land silently.
+    t = plan_target(
+        plan_schedule="1f1b",
+        plan_collective_records=(
+            ("ppermute", ("stage",), "f32",
+             "jit(f)/plan_wire/ppermute", 64),
+            ("ppermute", ("stage",), "f32",
+             "jit(f)/plan_wire/ppermute", 64),
+            ("ppermute", ("stage",), "f32",
+             "jit(f)/plan_wire/ppermute", 64),
+            ("psum", ("stage", "data", "seq"), "f32",
+             "jit(f)/plan_grad/psum", 64),
+        ),
+    )
+    found = check("plan-wire-fabric", t, module([]), MESH8)
+    assert found and "table-driven replay" in found[0].message
 
 
 @pytest.mark.hlo_rule("plan-seq-fabric", "positive")

@@ -166,6 +166,50 @@ def test_cost_moe_exchange_flat_vs_hierarchical():
     assert hier - hier_int8 == pytest.approx(dcn_leg / 2, rel=1e-9)
 
 
+def test_cost_plan_bubble_factor_hand_computed():
+    """The scheduled-plan bubble (ISSUE 20): (VM+pp-1)/(VM) with V
+    only counting for the interleaved schedule and M defaulting to
+    pp*V — so gpipe/1f1b twins at one M share a bubble and the
+    interleaved twin's is strictly smaller; pp=1 has no bubble."""
+    assert cost.plan_bubble_factor(1) == 1.0
+    assert cost.plan_bubble_factor(2) == pytest.approx(1.5)  # M=pp
+    assert cost.plan_bubble_factor(2, "gpipe", 1, 4) \
+        == pytest.approx(1.25)
+    assert cost.plan_bubble_factor(2, "1f1b", 1, 4) \
+        == pytest.approx(1.25)
+    assert cost.plan_bubble_factor(2, "interleaved", 2, 4) \
+        == pytest.approx(1.125)
+    # default M = pp*V for interleaved: (pp*V*V... ) = (8+1)/8
+    assert cost.plan_bubble_factor(2, "interleaved", 2) \
+        == pytest.approx(1.125)
+
+
+def test_cost_composed_plan_step_schedule_terms():
+    """`composed_plan_step_s` stays byte-stable for pre-ISSUE-20
+    callers (gpipe defaults price the old M+pp-1 wire ticks) and the
+    scheduled closed form honestly prices MORE wire ticks
+    (2MV + 2(pp-1)) while the compute term folds the bubble — the
+    cross-schedule win lives in the lowered tier where comm is
+    schedule-symmetric."""
+    args = (2, 1, 4, 1_000_000, 4, 128, 64, 1000, 8, 8, 1)
+    base = cost.composed_plan_step_s(*args)
+    assert base == cost.composed_plan_step_s(
+        *args, schedule="gpipe", virtual_stages=1,
+        num_microbatches=0, compute_s=0.0,
+    )
+    sched = cost.composed_plan_step_s(
+        *args, schedule="1f1b", num_microbatches=4,
+    )
+    assert sched > cost.composed_plan_step_s(*args, num_microbatches=4)
+    # the compute fold is compute_s * bubble, additively
+    with_c = cost.composed_plan_step_s(
+        *args, schedule="1f1b", num_microbatches=4, compute_s=1.0,
+    )
+    assert with_c - sched == pytest.approx(
+        cost.plan_bubble_factor(2, "1f1b", 1, 4), rel=1e-9,
+    )
+
+
 def test_predict_collectives_walker_hand_computed():
     """The HLO walker's per-kind pricing on a hand-built module: one
     ring hop within 'ici', one all-reduce crossing 'dcn'."""

@@ -163,8 +163,12 @@ def test_build_plan_engine_refusals():
     with pytest.raises(ValueError, match="no experts"):
         build_plan_engine(TINY, SGD(), "ep2")
     moe_cfg = dataclasses.replace(TINY, num_experts=4)
-    with pytest.raises(NotImplementedError, match="ROADMAP item 1"):
+    # The refusal names the offending ParallelPlan FIELD and the flag
+    # that sets it (ISSUE 20's guard convention), not a roadmap item.
+    with pytest.raises(NotImplementedError, match="ParallelPlan.ep"):
         build_plan_engine(moe_cfg, SGD(), "pp2xep2")
+    with pytest.raises(NotImplementedError, match="--plan"):
+        build_plan_engine(moe_cfg, SGD(), "sp2xep2")
     # uniform stage slices: pp must divide the layer stack
     with pytest.raises(ValueError, match="num_layers"):
         build_plan_engine(
@@ -268,6 +272,223 @@ def test_composed_plan_num_microbatches_above_pp():
         float(m["loss_sum"]) / float(m["count"]), float(dense_loss),
         rtol=1e-5,
     )
+
+
+# ------------------------------------------- scheduled plans (ISSUE 20)
+
+
+def test_parse_plan_schedule_suffix_roundtrip():
+    """`-1f1b` / `-int<V>` on the pp token are ParallelPlan.schedule /
+    .virtual_stages; the spec string round-trips, including the dashed
+    `pp2-1f1b-xsp2` form the checkpoint satellite saves under."""
+    p = parse_plan("pp2-1f1bxsp2xdp2")
+    assert (p.pp, p.tp_or_sp, p.dp) == (2, 2, 2)
+    assert p.schedule == "1f1b" and p.virtual_stages == 1
+    assert parse_plan(p.spec) == p
+    q = parse_plan("pp4-int2xdp2")
+    assert q.schedule == "interleaved" and q.virtual_stages == 2
+    assert parse_plan(q.spec) == q
+    # dashed-separator tolerance: `pp2-1f1b-xsp2` == `pp2-1f1bxsp2`
+    assert parse_plan("pp2-1f1b-xsp2") == parse_plan("pp2-1f1bxsp2")
+    # default stays gpipe and prints without a suffix
+    assert parse_plan("pp2xdp2").schedule == "gpipe"
+    assert "-" not in parse_plan("pp2xdp2").spec
+
+
+@pytest.mark.parametrize("bad", [
+    "pp2-int1",     # V=1 interleaving is spelled 1f1b
+    "sp2-1f1b",     # schedule suffix only composes with the pp token
+    "dp4-int2",
+    "pp1-1f1b",     # a schedule needs a pipeline (pp >= 2)
+    "pp2-gpipe",    # gpipe is the default, not a suffix
+])
+def test_parse_plan_rejects_bad_schedule_specs(bad):
+    with pytest.raises(ValueError):
+        parse_plan(bad)
+
+
+def test_scheduled_plan_guards_name_field_and_flag():
+    """ISSUE 20 guard convention: refusals name the ParallelPlan field
+    AND the flag that sets it, fail-fast at build time."""
+    # interleaved needs M >= pp * V to fill every virtual stage
+    with pytest.raises(ValueError, match="num_microbatches"):
+        build_plan_engine(
+            TINY, SGD(), "pp2-int2xdp2", num_microbatches=2,
+        )
+    # V * pp must divide the block count (TINY has 4 layers)
+    with pytest.raises(ValueError, match="num_layers"):
+        build_plan_engine(TINY, SGD(), "pp2-int4xdp2")
+    with pytest.raises(ValueError, match="virtual_stages"):
+        ParallelPlan(pp=2, schedule="interleaved", virtual_stages=1)
+    with pytest.raises(ValueError, match="schedule"):
+        ParallelPlan(pp=1, schedule="1f1b")
+
+
+def test_fsdp_per_parameter_layout():
+    """The plan's fsdp bit uses the single-axis FSDPEngine's
+    per-parameter layout (ISSUE 20), not whole-leaf 1/dp: leaves under
+    `min_shard_elems` stay replicated P(), big leaves shard 1/dp on
+    'data', and AdamW moments sit alongside their parameter with the
+    SAME per-leaf spec."""
+    from jax.sharding import PartitionSpec as P
+
+    from distributed_model_parallel_tpu.training.optim import AdamW
+
+    eng = build_plan_engine(TINY, AdamW(), "fsdp8", donate=False)
+    specs = eng.state_partition_specs()
+    is_spec = lambda x: isinstance(x, P)  # noqa: E731
+    leaves = jax.tree_util.tree_leaves(specs.params, is_leaf=is_spec)
+    sharded = [s for s in leaves if s != P()]
+    repl = [s for s in leaves if s == P()]
+    # per-parameter means BOTH kinds coexist in one params tree
+    assert sharded, "no leaf sharded — not an fsdp layout"
+    assert repl, "every leaf sharded — min_shard_elems ignored"
+    assert all(
+        any(part == "data" for part in s if part is not None)
+        for s in sharded
+    )
+    # moments mirror the per-leaf layout exactly
+    assert jax.tree_util.tree_leaves(
+        specs.opt_state.mu, is_leaf=is_spec
+    ) == leaves
+    assert jax.tree_util.tree_leaves(
+        specs.opt_state.nu, is_leaf=is_spec
+    ) == leaves
+
+
+def test_composed_1f1b_matches_dense_trajectory():
+    """THE acceptance pin (ISSUE 20): the pp2-1f1b x sp2 x dp2
+    scheduled plan on the 8-device mesh follows the dense 3-step
+    trajectory — losses, token counts, final params, eval — at
+    rtol 1e-5."""
+    _run_parity("pp2-1f1bxsp2xdp2")
+
+
+@pytest.mark.slow
+def test_composed_interleaved_matches_dense_trajectory():
+    """Interleaved V=2 (two virtual stages per device, M=4 default)
+    follows the dense trajectory. `slow` (one more composed compile);
+    tier-1 twin: test_composed_1f1b_matches_dense_trajectory — the
+    same table-driven tick program with V=1 tables."""
+    _run_parity("pp2-int2xdp2")
+
+
+@pytest.mark.slow
+def test_composed_1f1b_fsdp_matches_dense_trajectory():
+    """1F1B over the per-parameter fsdp layout: scheduled per-block
+    gathers compose with ZeRO-3 sharding and stay exactly dense.
+    `slow` (tier-1 budget); tier-1 twins:
+    test_composed_1f1b_matches_dense_trajectory (the schedule) +
+    test_fsdp_per_parameter_layout (the layout)."""
+    _run_parity("pp2-1f1bxfsdp4")
+
+
+def test_1f1b_bit_identical_to_gpipe_twin():
+    """At M == S the 1F1B table IS the gpipe fill-drain order (all
+    forwards, then all backwards, same microbatch order), so the final
+    params after 3 steps must be BIT-identical to the gpipe twin —
+    the 'math-preserving schedule' half of the ISSUE 20 parity bar."""
+    finals = []
+    for spec in ("pp2xdp4", "pp2-1f1bxdp4"):
+        eng = build_plan_engine(TINY, SGD(), spec, donate=False)
+        ts = eng.init_state(jax.random.PRNGKey(0))
+        ids = _ids(seed=11)
+        ids_s, tg_s = eng.shard_batch(ids)
+        for _ in range(3):
+            ts, _ = eng.train_step(ts, ids_s, tg_s, jnp.float32(LR))
+        finals.append(eng.to_canonical(ts).params)
+    for (path, a), b in zip(
+        jax.tree_util.tree_leaves_with_path(finals[0]),
+        jax.tree_util.tree_leaves(finals[1]),
+    ):
+        assert np.array_equal(np.asarray(a), np.asarray(b)), (
+            f"1f1b twin diverged bitwise: {jax.tree_util.keystr(path)}"
+        )
+
+
+def _payload_leading_dims(lowered_text, min_payload=2048):
+    """Leading dims of every f32 buffer in the lowered StableHLO
+    (`tensor<AxB..xf32>`) whose per-row payload is at least
+    `min_payload` elements — the activation stacks; tiny control
+    tensors are noise."""
+    import re as _re
+
+    dims = set()
+    for m in _re.finditer(r"tensor<(\d+(?:x\d+)+)xf32>", lowered_text):
+        shape = [int(x) for x in m.group(1).split("x")]
+        payload = 1
+        for d in shape[1:]:
+            payload *= d
+        if payload >= min_payload:
+            dims.add(shape[0])
+    return dims
+
+
+def test_1f1b_activation_memory_structurally_o_s_not_o_m():
+    """The structural O(S)-vs-O(M) pin (ISSUE 20) from lowered HLO:
+    at M=8 >> S=2 the gpipe program stacks per-microbatch residuals
+    (an f32 buffer with leading dim >= M appears), while the 1F1B
+    program's largest leading dim stays below M — its stash depth is
+    min(S, M), independent of M."""
+    rng = np.random.RandomState(2)
+    ids = rng.randint(1, TINY.vocab_size, size=(16, T)).astype(np.int32)
+    dims = {}
+    for spec in ("pp2xdp2", "pp2-1f1bxdp2"):
+        eng = build_plan_engine(
+            TINY, SGD(), spec, num_microbatches=8, donate=False,
+        )
+        ts = eng.init_state(jax.random.PRNGKey(0))
+        ids_s, tg_s = eng.shard_batch(ids)
+        txt = eng.train_step.lower(
+            ts, ids_s, tg_s, jnp.float32(LR)
+        ).as_text()
+        dims[spec] = _payload_leading_dims(txt)
+    M, S = 8, 2
+    assert max(dims["pp2xdp2"]) >= M, dims
+    # 1f1b: stacked block params give leading dim num_layers=4; no
+    # activation stack reaches M
+    assert max(dims["pp2-1f1bxdp2"]) < M, dims
+    # and the schedule table itself pins the tight O(S) bound
+    eng = build_plan_engine(
+        TINY, SGD(), "pp2-1f1bxdp2", num_microbatches=8, donate=False,
+    )
+    assert eng._sched.stash_depth <= min(S, M)
+
+
+def test_scheduled_layouts_identical_to_gpipe_twin():
+    """Schedule is execution-only: a scheduled plan declares the SAME
+    state_partition_specs as its gpipe twin (checkpoints reshard
+    across schedules through the canonical seam for free)."""
+    for a, b in (
+        ("pp2xsp2xdp2", "pp2-1f1bxsp2xdp2"),
+        ("pp2xfsdp4", "pp2-int2xfsdp4"),
+    ):
+        sa = build_plan_engine(
+            TINY, SGD(), a, donate=False
+        ).state_partition_specs()
+        sb = build_plan_engine(
+            TINY, SGD(), b, donate=False
+        ).state_partition_specs()
+        assert jax.tree_util.tree_structure(sa) == \
+            jax.tree_util.tree_structure(sb)
+        assert jax.tree_util.tree_leaves(sa) == \
+            jax.tree_util.tree_leaves(sb), (a, b)
+
+
+def test_degenerate_scheduled_plan_routes_to_pipeline_engine():
+    """A pp-only scheduled plan routes to the single-axis
+    LMPipelineEngine with the schedule and V threaded through (the
+    degenerate-plan map extends to schedules)."""
+    from distributed_model_parallel_tpu.parallel.pipeline import (
+        LMPipelineEngine,
+    )
+
+    eng = build_plan_engine(TINY, SGD(), "pp2-1f1b", donate=False)
+    assert isinstance(eng, LMPipelineEngine)
+    assert eng.schedule == "1f1b"
+    eng = build_plan_engine(TINY, SGD(), "pp2-int2", donate=False)
+    assert isinstance(eng, LMPipelineEngine)
+    assert eng.schedule == "interleaved" and eng.virtual_stages == 2
 
 
 # ------------------------------------------------- layout declarations
